@@ -1,0 +1,69 @@
+"""distributed_tpu — a TPU-native distributed dynamic task-scheduling framework.
+
+Capabilities of dask/distributed (reference at /root/reference), re-architected
+TPU-first: a central asynchronous Scheduler whose hot loops (worker placement,
+the transition engine, work stealing, replica management) run as jit-compiled
+JAX kernels over a device-array mirror of scheduler state, peer-to-peer
+Workers with a deterministic sans-IO state machine, a Client with Futures, and
+a pluggable comm/serialization stack.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from distributed_tpu import config
+from distributed_tpu.graph import Graph, TaskRef, TaskSpec
+
+__all__ = [
+    "config",
+    "Graph",
+    "TaskRef",
+    "TaskSpec",
+    "__version__",
+]
+
+
+def __getattr__(name: str):
+    # Lazy re-exports so `import distributed_tpu` stays light and cycle-free.
+    if name in ("Client", "Future", "as_completed", "wait", "fire_and_forget"):
+        from distributed_tpu.client import client as _c
+
+        return getattr(_c, name)
+    if name == "Scheduler":
+        from distributed_tpu.scheduler.scheduler import Scheduler
+
+        return Scheduler
+    if name == "Worker":
+        from distributed_tpu.worker.worker import Worker
+
+        return Worker
+    if name == "Nanny":
+        from distributed_tpu.worker.nanny import Nanny
+
+        return Nanny
+    if name == "LocalCluster":
+        from distributed_tpu.deploy.local import LocalCluster
+
+        return LocalCluster
+    if name == "SpecCluster":
+        from distributed_tpu.deploy.spec import SpecCluster
+
+        return SpecCluster
+    if name == "Adaptive":
+        from distributed_tpu.deploy.adaptive import Adaptive
+
+        return Adaptive
+    if name in ("Semaphore", "Lock", "MultiLock", "Event", "Queue", "Variable", "Pub", "Sub"):
+        from distributed_tpu import coordination as _coord
+
+        return getattr(_coord, name)
+    if name == "Actor":
+        from distributed_tpu.client.actor import Actor
+
+        return Actor
+    if name in ("SchedulerPlugin", "WorkerPlugin", "NannyPlugin"):
+        from distributed_tpu.diagnostics import plugin as _p
+
+        return getattr(_p, name)
+    raise AttributeError(f"module 'distributed_tpu' has no attribute {name!r}")

@@ -1,0 +1,4 @@
+from distributed_tpu.graph.order import order, validate_order
+from distributed_tpu.graph.spec import Graph, Key, TaskRef, TaskSpec, tokenize
+
+__all__ = ["Graph", "Key", "TaskRef", "TaskSpec", "order", "tokenize", "validate_order"]

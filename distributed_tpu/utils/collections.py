@@ -1,0 +1,143 @@
+"""Specialized collections.
+
+Equivalents of the reference's ``distributed/collections.py``: ``HeapSet``
+(priority heap with set semantics backing the scheduler queue and worker
+ready-heaps, collections.py:34), ``LRU``, and ``sum_mappings``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import weakref
+from collections import OrderedDict
+from collections.abc import Callable, Iterator, Mapping
+from typing import Any, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class HeapSet(Generic[T]):
+    """A set whose elements pop in priority order.
+
+    ``key(el)`` must return a totally-ordered priority; lower pops first.
+    Membership, add and discard are O(1)/O(log n); stale heap entries are
+    lazily skipped on pop/peek (same design as the reference's HeapSet).
+    """
+
+    def __init__(self, *, key: Callable[[T], Any]):
+        self.key = key
+        self._data: set[T] = set()
+        self._heap: list[tuple[Any, int, Any]] = []
+        self._inc = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, el: object) -> bool:
+        return el in self._data
+
+    def __bool__(self) -> bool:
+        return bool(self._data)
+
+    def __repr__(self) -> str:
+        return f"<HeapSet: {len(self)} items>"
+
+    def add(self, el: T) -> None:
+        if el in self._data:
+            return
+        self._inc += 1
+        self._data.add(el)
+        try:
+            ref: Any = weakref.ref(el)
+        except TypeError:
+            ref = lambda el=el: el  # noqa: E731
+        heapq.heappush(self._heap, (self.key(el), self._inc, ref))
+
+    def discard(self, el: T) -> None:
+        self._data.discard(el)
+        if not self._data:
+            self._heap.clear()
+
+    def remove(self, el: T) -> None:
+        if el not in self._data:
+            raise KeyError(el)
+        self.discard(el)
+
+    def peek(self) -> T:
+        if not self._data:
+            raise KeyError("peek into empty set")
+        while True:
+            el = self._heap[0][2]()
+            if el is not None and el in self._data:
+                return el
+            heapq.heappop(self._heap)
+
+    def pop(self) -> T:
+        if not self._data:
+            raise KeyError("pop from an empty set")
+        while True:
+            _, _, ref = heapq.heappop(self._heap)
+            el = ref()
+            if el is not None and el in self._data:
+                self._data.discard(el)
+                return el
+
+    def popright(self) -> T:
+        """Pop the *largest* priority element (linear scan; used rarely)."""
+        if not self._data:
+            raise KeyError("pop from an empty set")
+        el = max(self._data, key=self.key)
+        self.discard(el)
+        return el
+
+    def peekn(self, n: int) -> Iterator[T]:
+        """Iterate over the n smallest elements without removing them."""
+        if n <= 0 or not self._data:
+            return
+        popped = []
+        try:
+            for _ in range(min(n, len(self._data))):
+                el = self.pop()
+                popped.append(el)
+                yield el
+        finally:
+            for el in popped:
+                self.add(el)
+
+    def sorted(self) -> list[T]:
+        return sorted(self._data, key=self.key)
+
+    def __iter__(self) -> Iterator[T]:
+        return iter(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()
+        self._heap.clear()
+
+
+class LRU(OrderedDict):
+    """Dict with a maximum size, evicting the least recently *set* item."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def __getitem__(self, key):
+        value = super().__getitem__(key)
+        self.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        if len(self) > self.maxsize:
+            self.popitem(last=False)
+
+
+def sum_mappings(maps: Iterator[Mapping[Any, float]]) -> dict[Any, float]:
+    out: dict[Any, float] = {}
+    for m in maps:
+        if isinstance(m, Mapping):
+            m = m.items()  # type: ignore
+        for k, v in m:  # type: ignore
+            out[k] = out.get(k, 0) + v
+    return out

@@ -1,0 +1,102 @@
+"""Byte-size estimation of task results (reference: distributed/sizeof.py +
+dask.sizeof single-dispatch).  Used by workers to report ``nbytes`` to the
+scheduler's memory model."""
+
+from __future__ import annotations
+
+import sys
+from functools import singledispatch
+from typing import Any
+
+
+@singledispatch
+def sizeof(obj: Any) -> int:
+    try:
+        return sys.getsizeof(obj)
+    except Exception:
+        return 64
+
+
+@sizeof.register(list)
+@sizeof.register(tuple)
+@sizeof.register(set)
+@sizeof.register(frozenset)
+def _sizeof_seq(obj) -> int:
+    n = sys.getsizeof(obj)
+    if len(obj) > 10_000:  # sample large containers
+        import itertools
+
+        sample = list(itertools.islice(obj, 1000))
+        return n + int(len(obj) / len(sample) * sum(sizeof(x) for x in sample))
+    return n + sum(sizeof(x) for x in obj)
+
+
+@sizeof.register(dict)
+def _sizeof_dict(obj: dict) -> int:
+    return (
+        sys.getsizeof(obj)
+        + sum(sizeof(k) for k in obj.keys())
+        + sum(sizeof(v) for v in obj.values())
+    )
+
+
+@sizeof.register(bytes)
+@sizeof.register(bytearray)
+def _sizeof_bytes(obj) -> int:
+    return len(obj)
+
+
+@sizeof.register(memoryview)
+def _sizeof_memoryview(obj: memoryview) -> int:
+    return obj.nbytes
+
+
+def _register_numpy() -> None:
+    import numpy as np
+
+    @sizeof.register(np.ndarray)
+    def _sizeof_ndarray(obj: np.ndarray) -> int:
+        return max(int(obj.nbytes), 64)
+
+    @sizeof.register(np.generic)
+    def _sizeof_npscalar(obj) -> int:
+        return obj.nbytes
+
+
+def _register_jax() -> None:
+    import jax
+
+    @sizeof.register(jax.Array)
+    def _sizeof_jax(obj: jax.Array) -> int:
+        return max(int(obj.size * obj.dtype.itemsize), 64)
+
+
+def _register_pandas() -> None:
+    import pandas as pd
+
+    @sizeof.register(pd.DataFrame)
+    def _sizeof_df(obj: pd.DataFrame) -> int:
+        return max(int(obj.memory_usage(deep=True).sum()), 64)
+
+    @sizeof.register(pd.Series)
+    def _sizeof_series(obj: pd.Series) -> int:
+        return max(int(obj.memory_usage(deep=True)), 64)
+
+    @sizeof.register(pd.Index)
+    def _sizeof_index(obj: pd.Index) -> int:
+        return max(int(obj.memory_usage(deep=True)), 64)
+
+
+for _reg in (_register_numpy, _register_jax, _register_pandas):
+    try:
+        _reg()
+    except ImportError:  # pragma: no cover
+        pass
+
+
+def safe_sizeof(obj: Any, default: int = 1_000_000) -> int:
+    """Never-raising sizeof (reference sizeof.py:safe_sizeof)."""
+    try:
+        return sizeof(obj)
+    except Exception:
+        return default

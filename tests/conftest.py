@@ -1,0 +1,54 @@
+"""Test harness configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh (multi-chip shardings are
+validated without TPU hardware, like the reference validates multi-node
+behavior with in-process clusters, utils_test.py:865).  Must run before any
+jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import asyncio  # noqa: E402
+import contextlib  # noqa: E402
+import functools  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def gen_test(timeout: float = 60):
+    """Run an async test on a fresh event loop (reference utils_test.py:708)."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            async def run():
+                return await asyncio.wait_for(fn(*args, **kwargs), timeout)
+
+            return asyncio.run(run())
+
+        return wrapper
+
+    return decorator
+
+
+@contextlib.contextmanager
+def config_override(**kwargs):
+    from distributed_tpu import config
+
+    with config.set(**kwargs):
+        yield

@@ -1,0 +1,70 @@
+import pytest
+
+from distributed_tpu.utils import LRU, HeapSet
+
+
+class El:
+    def __init__(self, name, pri):
+        self.name = name
+        self.pri = pri
+
+    def __repr__(self):
+        return f"El({self.name})"
+
+
+def test_heapset_basic():
+    hs = HeapSet(key=lambda el: el.pri)
+    a, b, c = El("a", 3), El("b", 1), El("c", 2)
+    for el in (a, b, c):
+        hs.add(el)
+    assert len(hs) == 3
+    assert b in hs
+    assert hs.peek() is b
+    assert hs.pop() is b
+    assert hs.pop() is c
+    assert hs.pop() is a
+    assert len(hs) == 0
+    with pytest.raises(KeyError):
+        hs.pop()
+
+
+def test_heapset_discard_and_stale_entries():
+    hs = HeapSet(key=lambda el: el.pri)
+    a, b = El("a", 1), El("b", 2)
+    hs.add(a)
+    hs.add(b)
+    hs.discard(a)
+    assert hs.peek() is b
+    hs.add(a)  # re-add with same priority
+    assert hs.pop() is a
+
+
+def test_heapset_peekn():
+    hs = HeapSet(key=lambda el: el.pri)
+    els = [El(str(i), i) for i in [5, 3, 8, 1]]
+    for el in els:
+        hs.add(el)
+    names = [el.name for el in hs.peekn(3)]
+    assert names == ["1", "3", "5"]
+    assert len(hs) == 4  # peekn restores
+
+
+def test_heapset_add_idempotent():
+    hs = HeapSet(key=lambda el: el.pri)
+    a = El("a", 1)
+    hs.add(a)
+    hs.add(a)
+    assert len(hs) == 1
+    hs.pop()
+    assert len(hs) == 0
+
+
+def test_lru():
+    lru = LRU(maxsize=2)
+    lru["a"] = 1
+    lru["b"] = 2
+    lru["c"] = 3
+    assert "a" not in lru
+    assert lru["b"] == 2
+    lru["d"] = 4
+    assert "c" not in lru  # b was touched, c evicted

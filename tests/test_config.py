@@ -1,0 +1,51 @@
+from distributed_tpu import config
+
+
+def test_defaults_loaded():
+    assert config.get("scheduler.worker-saturation") == 1.1
+    assert config.get("scheduler.allowed-failures") == 3
+    assert config.get("scheduler.bandwidth") == 100_000_000
+    assert config.get("worker.memory.target") == 0.60
+
+
+def test_get_default():
+    assert config.get("no.such.path", 42) == 42
+
+
+def test_set_restore():
+    with config.set({"scheduler.worker-saturation": 2.0}):
+        assert config.get("scheduler.worker-saturation") == 2.0
+    assert config.get("scheduler.worker-saturation") == 1.1
+
+
+def test_set_kwargs():
+    with config.set(scheduler__work_stealing=False):
+        assert config.get("scheduler.work-stealing") is False
+    assert config.get("scheduler.work-stealing") is True
+
+
+def test_parse_timedelta():
+    assert config.parse_timedelta("100ms") == 0.1
+    assert config.parse_timedelta("5 minutes") == 300.0
+    assert config.parse_timedelta("1us") == 1e-6
+    assert config.parse_timedelta(3) == 3.0
+    assert config.parse_timedelta(None) is None
+    assert config.parse_timedelta("5") == 5.0
+
+
+def test_parse_bytes():
+    assert config.parse_bytes("64MiB") == 64 * 2**20
+    assert config.parse_bytes("50MB") == 50_000_000
+    assert config.parse_bytes(123) == 123
+    assert config.parse_bytes("1.5kb") == 1500
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("DTPU_SCHEDULER__WORKER_SATURATION", "3.5")
+    config.refresh()
+    try:
+        assert config.get("scheduler.worker-saturation") == 3.5
+    finally:
+        monkeypatch.delenv("DTPU_SCHEDULER__WORKER_SATURATION")
+        config.refresh()
+    assert config.get("scheduler.worker-saturation") == 1.1

@@ -1,0 +1,110 @@
+import operator
+
+import pytest
+
+from distributed_tpu.graph import Graph, TaskRef, TaskSpec, order, validate_order
+
+
+def test_taskspec_dependencies():
+    spec = TaskSpec(operator.add, (TaskRef("x"), 1))
+    assert spec.dependencies() == {"x"}
+    spec = TaskSpec(sum, ([TaskRef("a"), TaskRef("b")],), {"start": TaskRef("c")})
+    assert spec.dependencies() == {"a", "b", "c"}
+
+
+def test_taskspec_substitute():
+    spec = TaskSpec(operator.add, (TaskRef("x"), 10))
+    fn, args, kwargs = spec.substitute({"x": 32})
+    assert fn(*args, **kwargs) == 42
+
+
+def test_graph_build_and_validate():
+    g = Graph()
+    g["a"] = 1
+    g["b"] = TaskSpec(operator.add, (TaskRef("a"), 1))
+    k = g.add(operator.mul, TaskRef("b"), 3)
+    g.validate()
+    deps = g.dependencies()
+    assert deps["b"] == {"a"}
+    assert deps[k] == {"b"}
+
+
+def test_graph_missing_dep():
+    g = Graph({"b": TaskSpec(operator.add, (TaskRef("zzz"), 1))})
+    with pytest.raises(ValueError, match="missing"):
+        g.validate()
+
+
+def test_graph_cycle():
+    g = Graph(
+        {
+            "a": TaskSpec(operator.neg, (TaskRef("b"),)),
+            "b": TaskSpec(operator.neg, (TaskRef("a"),)),
+        }
+    )
+    with pytest.raises(ValueError, match="cycle"):
+        g.validate()
+
+
+def test_order_linear_chain():
+    deps = {"a": set(), "b": {"a"}, "c": {"b"}, "d": {"c"}}
+    ranks = order(deps)
+    validate_order(deps, ranks)
+    assert ranks["a"] < ranks["b"] < ranks["c"] < ranks["d"]
+
+
+def test_order_diamond():
+    deps = {"a": set(), "b": {"a"}, "c": {"a"}, "d": {"b", "c"}}
+    ranks = order(deps)
+    validate_order(deps, ranks)
+
+
+def test_order_depth_first_reduction():
+    # map-reduce tree: order should complete one branch before starting another
+    deps = {
+        "x0": set(), "x1": set(), "x2": set(), "x3": set(),
+        "s0": {"x0", "x1"}, "s1": {"x2", "x3"},
+        "total": {"s0", "s1"},
+    }
+    ranks = order(deps)
+    validate_order(deps, ranks)
+    # one full branch (both leaves + its sum) finishes before the other starts
+    b0 = max(ranks["x0"], ranks["x1"], ranks["s0"])
+    b1 = max(ranks["x2"], ranks["x3"], ranks["s1"])
+    lo0 = min(ranks["x0"], ranks["x1"], ranks["s0"])
+    lo1 = min(ranks["x2"], ranks["x3"], ranks["s1"])
+    assert b0 < lo1 or b1 < lo0
+
+
+def test_order_independent_components_dont_interleave():
+    deps = {}
+    for comp in ("l", "r"):
+        deps[f"{comp}0"] = set()
+        deps[f"{comp}1"] = {f"{comp}0"}
+        deps[f"{comp}2"] = {f"{comp}1"}
+    ranks = order(deps)
+    validate_order(deps, ranks)
+    left = [ranks[f"l{i}"] for i in range(3)]
+    right = [ranks[f"r{i}"] for i in range(3)]
+    assert max(left) < min(right) or max(right) < min(left)
+
+
+def test_order_cycle_detection():
+    deps = {"a": {"b"}, "b": {"a"}}
+    with pytest.raises(ValueError, match="cycle"):
+        order(deps)
+
+
+def test_order_large_random():
+    import random
+
+    rng = random.Random(0)
+    deps = {"k0": set()}
+    keys = ["k0"]
+    for i in range(1, 2000):
+        k = f"k{i}"
+        nd = rng.randint(0, min(3, len(keys)))
+        deps[k] = set(rng.sample(keys, nd))
+        keys.append(k)
+    ranks = order(deps)
+    validate_order(deps, ranks)
