@@ -243,9 +243,22 @@ def set(arg: Mapping[str, Any] | None = None, **kwargs: Any):
     old: dict[str, Any] = {}
     with _lock:
         for path, value in updates.items():
-            old[path] = get(path, None)
+            old[path] = get(path, _absent)
             _set_path(_config, path, value)
     return _ConfigRestore(old)
+
+
+_absent = object()
+
+
+def _del_path(cfg: dict, path: str) -> None:
+    keys = path.split(".")
+    d = cfg
+    for k in keys[:-1]:
+        d = d.get(k)
+        if not isinstance(d, dict):
+            return
+    d.pop(keys[-1], None)
 
 
 class _ConfigRestore:
@@ -258,7 +271,10 @@ class _ConfigRestore:
     def __exit__(self, *exc):
         with _lock:
             for path, value in self._old.items():
-                _set_path(_config, path, value)
+                if value is _absent:
+                    _del_path(_config, path)
+                else:
+                    _set_path(_config, path, value)
 
 
 @contextmanager

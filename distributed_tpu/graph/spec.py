@@ -126,10 +126,7 @@ class Graph:
     def dependencies(self) -> dict[Key, set[Key]]:
         out: dict[Key, set[Key]] = {}
         for key, spec in self.tasks.items():
-            if isinstance(spec, TaskSpec):
-                out[key] = {d for d in spec.dependencies() if d in self.tasks or True}
-            else:
-                out[key] = set()
+            out[key] = spec.dependencies() if isinstance(spec, TaskSpec) else set()
         return out
 
     def validate(self) -> None:

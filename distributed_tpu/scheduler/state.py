@@ -1,0 +1,1933 @@
+"""Scheduler state machine — the pure control-plane core.
+
+This is the sans-IO heart of the scheduler, the equivalent of the reference's
+``SchedulerState`` (scheduler.py:1554): every task in the cluster moves
+through the states
+
+    released -> waiting -> [processing | queued | no-worker] -> memory
+                                   \\-> erred
+    (any) -> released -> forgotten
+
+via a transition engine: ``_transition(key, finish)`` dispatches on the
+``(start, finish)`` pair (reference _TRANSITIONS_TABLE, scheduler.py:2889);
+each handler mutates state and returns ``(recommendations, client_msgs,
+worker_msgs)``; ``_transitions`` (scheduler.py:2045) drains recommendations
+to a fixed point.  Every transition is appended to ``transition_log`` with a
+``stimulus_id`` for causal tracing (``story``).
+
+Worker placement (``decide_worker_*``, reference scheduler.py:2135-2336 and
+module-level decide_worker :8550) is routed through ``self.placement`` — by
+default the pure-python objective below, optionally the JAX co-processor in
+``distributed_tpu.ops.placement`` which batches these decisions into
+cost-matrix kernels on device (the framework's north star).
+
+This class performs **no IO**: it returns message dicts destined for workers
+and clients; the networked ``Scheduler`` server drains them onto batched
+comm streams.  That makes the whole control plane deterministic and unit
+testable (reference test strategy tier 1, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict, deque
+from collections.abc import Iterable
+from typing import Any, Callable
+
+from distributed_tpu import config
+from distributed_tpu.exceptions import (
+    InvalidTaskState,
+    InvalidTransition,
+    KilledWorker,
+    NoValidWorkerError,
+    TransitionCounterMaxExceeded,
+)
+from distributed_tpu.graph.spec import TaskSpec
+from distributed_tpu.utils import HeapSet, key_split, time
+
+logger = logging.getLogger("distributed_tpu.scheduler")
+
+Key = str
+
+ALL_TASK_STATES = (
+    "released",
+    "waiting",
+    "no-worker",
+    "queued",
+    "processing",
+    "memory",
+    "erred",
+    "forgotten",
+)
+
+# worker lifecycle statuses (subset of reference Status enum, core.py:77)
+WORKER_STATUS_RUNNING = "running"
+WORKER_STATUS_PAUSED = "paused"
+WORKER_STATUS_CLOSING = "closing"
+WORKER_STATUS_CLOSING_GRACEFULLY = "closing_gracefully"
+WORKER_STATUS_INIT = "init"
+
+RUNNING_STATUSES = frozenset({WORKER_STATUS_RUNNING})
+
+
+class TaskPrefix:
+    """Statistics per function name, used for duration estimation
+    (reference scheduler.py:923)."""
+
+    __slots__ = (
+        "name",
+        "duration_average",
+        "max_exec_time",
+        "nbytes_total",
+        "state_counts",
+        "groups",
+        "n_durations",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.duration_average: float = -1.0
+        self.max_exec_time: float = -1.0
+        self.nbytes_total = 0
+        self.n_durations = 0
+        self.state_counts: defaultdict[str, int] = defaultdict(int)
+        self.groups: set[TaskGroup] = set()
+
+    def add_exec_time(self, duration: float) -> None:
+        self.max_exec_time = max(duration, self.max_exec_time)
+        if duration > 2 * self.duration_average:
+            self.duration_average = -1.0  # invalidate on surprise (ref :947)
+
+    def add_duration(self, duration: float) -> None:
+        self.n_durations += 1
+        if self.duration_average < 0:
+            self.duration_average = duration
+        else:
+            self.duration_average = 0.5 * duration + 0.5 * self.duration_average
+
+    def __repr__(self) -> str:
+        return f"<TaskPrefix {self.name!r}>"
+
+
+class TaskGroup:
+    """Statistics per key-group; unit of root-ish detection
+    (reference scheduler.py:1033)."""
+
+    __slots__ = (
+        "name",
+        "prefix",
+        "states",
+        "dependencies",
+        "nbytes_total",
+        "duration",
+        "types",
+        "start",
+        "stop",
+        "last_worker",
+        "last_worker_tasks_left",
+        "span_id",
+        "n_tasks",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.prefix: TaskPrefix | None = None
+        self.states: dict[str, int] = dict.fromkeys(ALL_TASK_STATES, 0)
+        self.dependencies: set[TaskGroup] = set()
+        self.nbytes_total = 0
+        self.duration = 0.0
+        self.types: set[str] = set()
+        self.start = 0.0
+        self.stop = 0.0
+        self.last_worker: WorkerState | None = None
+        self.last_worker_tasks_left = 0
+        self.span_id: str | None = None
+        self.n_tasks = 0
+
+    def add(self, ts: TaskState) -> None:
+        self.states[ts.state] += 1
+        self.n_tasks += 1
+        ts.group = self
+
+    def __len__(self) -> int:
+        return self.n_tasks
+
+    def __repr__(self) -> str:
+        return f"<TaskGroup {self.name!r}: {self.n_tasks} tasks>"
+
+    @property
+    def done(self) -> bool:
+        return sum(self.states.get(s, 0) for s in ("memory", "erred", "forgotten")) == self.n_tasks
+
+
+class TaskState:
+    """Per-task record on the scheduler (reference scheduler.py:1173)."""
+
+    __slots__ = (
+        "key",
+        "run_spec",
+        "priority",
+        "state",
+        "dependencies",
+        "dependents",
+        "waiting_on",
+        "waiters",
+        "who_wants",
+        "who_has",
+        "processing_on",
+        "nbytes",
+        "type",
+        "exception",
+        "traceback",
+        "exception_text",
+        "traceback_text",
+        "exception_blame",
+        "erred_on",
+        "suspicious",
+        "retries",
+        "host_restrictions",
+        "worker_restrictions",
+        "resource_restrictions",
+        "loose_restrictions",
+        "actor",
+        "prefix",
+        "group",
+        "metadata",
+        "annotations",
+        "run_id",
+        "queueable",
+        "_rootish",
+    )
+
+    def __init__(self, key: Key, run_spec: Any, state: str = "released"):
+        self.key = key
+        self.run_spec = run_spec
+        self.priority: tuple | None = None
+        self.state = state
+        self.dependencies: set[TaskState] = set()
+        self.dependents: set[TaskState] = set()
+        self.waiting_on: set[TaskState] = set()
+        self.waiters: set[TaskState] = set()
+        self.who_wants: set[ClientState] = set()
+        self.who_has: set[WorkerState] = set()
+        self.processing_on: WorkerState | None = None
+        self.nbytes = -1
+        self.type: str | None = None
+        self.exception: Any = None
+        self.traceback: Any = None
+        self.exception_text = ""
+        self.traceback_text = ""
+        self.exception_blame: TaskState | None = None
+        self.erred_on: set[str] = set()
+        self.suspicious = 0
+        self.retries = 0
+        self.host_restrictions: set[str] | None = None
+        self.worker_restrictions: set[str] | None = None
+        self.resource_restrictions: dict[str, float] | None = None
+        self.loose_restrictions = False
+        self.actor = False
+        self.prefix: TaskPrefix | None = None
+        self.group: TaskGroup | None = None
+        self.metadata: dict | None = None
+        self.annotations: dict | None = None
+        self.run_id: int | None = None
+        self.queueable = True
+        self._rootish: bool | None = None
+
+    def __repr__(self) -> str:
+        return f"<TaskState {self.key!r} {self.state}>"
+
+    def __hash__(self) -> int:
+        return hash(self.key)
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+    @property
+    def group_key(self) -> str:
+        return self.group.name if self.group else key_split(self.key)
+
+    def get_nbytes(self) -> int:
+        return self.nbytes if self.nbytes >= 0 else DEFAULT_DATA_SIZE
+
+    def add_dependency(self, dep: TaskState) -> None:
+        self.dependencies.add(dep)
+        if self.group is not None and dep.group is not None and dep.group is not self.group:
+            self.group.dependencies.add(dep.group)
+        dep.dependents.add(self)
+
+    @property
+    def has_restrictions(self) -> bool:
+        return bool(
+            self.host_restrictions or self.worker_restrictions or self.resource_restrictions
+        )
+
+
+DEFAULT_DATA_SIZE = 1024  # bytes assumed for unknown results
+
+
+class ClientState:
+    """Per-client record (reference scheduler.py:196)."""
+
+    __slots__ = ("client_key", "wants_what", "last_seen", "versions")
+
+    def __init__(self, client: str):
+        self.client_key = client
+        self.wants_what: set[TaskState] = set()
+        self.last_seen = time()
+        self.versions: dict = {}
+
+    def __repr__(self) -> str:
+        return f"<ClientState {self.client_key!r}>"
+
+    def __hash__(self) -> int:
+        return hash(self.client_key)
+
+
+class WorkerState:
+    """Scheduler-side mirror of one worker (reference scheduler.py:406)."""
+
+    __slots__ = (
+        "address",
+        "name",
+        "nthreads",
+        "memory_limit",
+        "status",
+        "nbytes",
+        "has_what",
+        "processing",
+        "long_running",
+        "executing",
+        "resources",
+        "used_resources",
+        "occupancy",
+        "_network_occ",
+        "last_seen",
+        "metrics",
+        "memory_unmanaged_old",
+        "bandwidth",
+        "actors",
+        "extra",
+        "server_id",
+        "idx",
+    )
+
+    def __init__(
+        self,
+        address: str,
+        nthreads: int = 1,
+        memory_limit: int = 0,
+        name: object = None,
+        server_id: str | None = None,
+    ):
+        self.address = address
+        self.name = name if name is not None else address
+        self.nthreads = nthreads
+        self.memory_limit = memory_limit
+        self.status = WORKER_STATUS_RUNNING
+        self.nbytes = 0
+        self.has_what: dict[TaskState, None] = {}  # insertion-ordered set
+        self.processing: dict[TaskState, float] = {}
+        self.long_running: set[TaskState] = set()
+        self.executing: dict[TaskState, float] = {}
+        self.resources: dict[str, float] = {}
+        self.used_resources: dict[str, float] = {}
+        self.occupancy = 0.0
+        self._network_occ = 0  # bytes pending transfer to this worker
+        self.last_seen = time()
+        self.metrics: dict = {}
+        self.memory_unmanaged_old = 0
+        self.bandwidth = float(config.get("scheduler.bandwidth"))
+        self.actors: set[TaskState] = set()
+        self.extra: dict = {}
+        self.server_id = server_id or address
+        self.idx = -1  # stable slot in the device mirror (ops/)
+
+    def __repr__(self) -> str:
+        return (
+            f"<WorkerState {self.address!r} status: {self.status} "
+            f"processing: {len(self.processing)} has_what: {len(self.has_what)}>"
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.server_id)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, WorkerState) and other.server_id == self.server_id
+
+    def clean(self) -> WorkerState:
+        ws = WorkerState(self.address, self.nthreads, self.memory_limit, self.name)
+        ws.status = self.status
+        return ws
+
+
+class SchedulerState:
+    """The whole mutable scheduler core (reference scheduler.py:1554)."""
+
+    def __init__(
+        self,
+        *,
+        validate: bool | None = None,
+        transition_counter_max: int | None = None,
+        placement: Any | None = None,
+    ):
+        self.tasks: dict[Key, TaskState] = {}
+        self.task_groups: dict[str, TaskGroup] = {}
+        self.task_prefixes: dict[str, TaskPrefix] = {}
+        self.workers: dict[str, WorkerState] = {}
+        self.aliases: dict[object, str] = {}  # name -> address
+        self.clients: dict[str, ClientState] = {}
+        self.host_info: defaultdict[str, dict] = defaultdict(dict)
+        self.resources: defaultdict[str, dict[str, float]] = defaultdict(dict)
+
+        self.idle: dict[str, WorkerState] = {}
+        self.idle_task_count: set[WorkerState] = set()
+        self.saturated: set[WorkerState] = set()
+        self.running: set[WorkerState] = set()
+
+        self.queued: HeapSet[TaskState] = HeapSet(key=lambda ts: ts.priority)
+        self.unrunnable: dict[TaskState, float] = {}
+        self.replicated_tasks: set[TaskState] = set()
+
+        self.validate = (
+            validate if validate is not None else config.get("scheduler.validate")
+        )
+        self.transition_counter = 0
+        self.transition_counter_max = transition_counter_max
+        self.transition_log: deque = deque(
+            maxlen=config.get("scheduler.transition-log-length")
+        )
+        self._transitions_table: dict[tuple[str, str], Callable] = {
+            ("released", "waiting"): self._transition_released_waiting,
+            ("waiting", "released"): self._transition_waiting_released,
+            ("waiting", "processing"): self._transition_waiting_processing,
+            ("waiting", "queued"): self._transition_waiting_queued,
+            ("waiting", "no-worker"): self._transition_waiting_no_worker,
+            ("waiting", "memory"): self._transition_waiting_memory,
+            ("queued", "released"): self._transition_queued_released,
+            ("queued", "processing"): self._transition_queued_processing,
+            ("processing", "released"): self._transition_processing_released,
+            ("processing", "memory"): self._transition_processing_memory,
+            ("processing", "erred"): self._transition_processing_erred,
+            ("no-worker", "released"): self._transition_no_worker_released,
+            ("no-worker", "processing"): self._transition_no_worker_processing,
+            ("released", "forgotten"): self._transition_released_forgotten,
+            ("memory", "forgotten"): self._transition_memory_forgotten,
+            ("erred", "released"): self._transition_erred_released,
+            ("memory", "released"): self._transition_memory_released,
+            ("released", "erred"): self._transition_released_erred,
+        }
+
+        # hot-path config cached at init (reference scheduler.py:1756-1791)
+        self.UNKNOWN_TASK_DURATION: float = config.parse_timedelta(
+            config.get("scheduler.unknown-task-duration")
+        )
+        ws_cfg = config.get("scheduler.worker-saturation")
+        self.WORKER_SATURATION: float = float("inf") if ws_cfg in ("inf", None) else float(ws_cfg)
+        self.bandwidth: float = float(config.get("scheduler.bandwidth"))
+        self.ALLOWED_FAILURES: int = config.get("scheduler.allowed-failures")
+        self.DEFAULT_TASK_DURATIONS: dict[str, float] = {
+            k: config.parse_timedelta(v)
+            for k, v in config.get("scheduler.default-task-durations").items()
+        }
+
+        self.total_nthreads = 0
+        self.total_nthreads_history: list[tuple[float, int]] = [(time(), 0)]
+        self._total_occupancy = 0.0
+        self.n_tasks = 0
+        self.plugins: dict[str, Any] = {}
+        self.placement = placement  # JAX co-processor hook (ops/placement.py)
+        self.extensions: dict[str, Any] = {}
+        self.events: defaultdict[str, deque] = defaultdict(
+            lambda: deque(maxlen=config.get("scheduler.events-log-length"))
+        )
+        self.event_counts: defaultdict[str, int] = defaultdict(int)
+        self.task_metadata: dict = {}
+        self.unknown_durations: dict[str, set[TaskState]] = {}
+
+    # ------------------------------------------------------------------ misc
+
+    @property
+    def memory_total(self) -> int:
+        return sum(ws.memory_limit for ws in self.workers.values())
+
+    def new_task_prefix(self, name: str) -> TaskPrefix:
+        tp = self.task_prefixes.get(name)
+        if tp is None:
+            tp = self.task_prefixes[name] = TaskPrefix(name)
+            if name in self.DEFAULT_TASK_DURATIONS:
+                tp.duration_average = self.DEFAULT_TASK_DURATIONS[name]
+        return tp
+
+    def new_task(
+        self,
+        key: Key,
+        run_spec: Any,
+        state: str = "released",
+        computation: Any = None,
+    ) -> TaskState:
+        """Create and register a new TaskState (reference scheduler.py:1817)."""
+        ts = TaskState(key, run_spec, state)
+        prefix_key = key_split(key)
+        tp = self.new_task_prefix(prefix_key)
+        ts.prefix = tp
+        tp.state_counts[state] += 1
+        group_key = prefix_key  # group == prefix family for string keys
+        tg = self.task_groups.get(group_key)
+        if tg is None:
+            tg = self.task_groups[group_key] = TaskGroup(group_key)
+            tg.prefix = tp
+            tp.groups.add(tg)
+        tg.add(ts)
+        self.tasks[key] = ts
+        self.n_tasks += 1
+        return ts
+
+    def _clear_task_state(self) -> None:
+        for coll in (
+            self.tasks,
+            self.task_groups,
+            self.task_prefixes,
+            self.unrunnable,
+            self.replicated_tasks,
+        ):
+            coll.clear()
+        self.queued.clear()
+
+    # ------------------------------------------------- transition engine
+
+    def _transition(
+        self, key: Key, finish: str, stimulus_id: str, **kwargs: Any
+    ) -> tuple[dict, dict, dict]:
+        """Move task ``key`` to state ``finish`` (reference scheduler.py:1909).
+
+        Returns (recommendations, client_msgs, worker_msgs).  Unknown
+        (start, finish) pairs route through "released" like the reference
+        (scheduler.py:1961-1984).
+        """
+        ts = self.tasks.get(key)
+        if ts is None:
+            return {}, {}, {}
+        start = ts.state
+        if start == finish:
+            return {}, {}, {}
+        if self.transition_counter_max:
+            if self.transition_counter >= self.transition_counter_max:
+                raise TransitionCounterMaxExceeded(key, start, finish, self.story(key))
+        self.transition_counter += 1
+
+        func = self._transitions_table.get((start, finish))
+        if func is not None:
+            recommendations, client_msgs, worker_msgs = func(
+                key, stimulus_id=stimulus_id, **kwargs
+            )
+        elif "released" not in (start, finish):
+            # untable'd pair: route through released (reference scheduler.py:1961)
+            assert not kwargs, (kwargs, start, finish)
+            a_recs, a_cmsgs, a_wmsgs = self._transition(key, "released", stimulus_id)
+            v = a_recs.get(key, finish)
+            func = self._transitions_table.get(("released", v))
+            if func is None:
+                raise InvalidTransition(key, start, finish, self.story(key))
+            b_recs, b_cmsgs, b_wmsgs = func(key, stimulus_id=stimulus_id)
+            recommendations = {**a_recs, **b_recs}
+            client_msgs = _merge_msgs(a_cmsgs, b_cmsgs)
+            worker_msgs = _merge_msgs(a_wmsgs, b_wmsgs)
+            start = "released"
+        else:
+            raise InvalidTransition(key, start, finish, self.story(key))
+
+        actual_finish = ts.state
+        self.transition_log.append(
+            (key, start, actual_finish, dict(recommendations), stimulus_id, time())
+        )
+        if self.validate:
+            self.validate_task_state(ts)
+        for plugin in list(self.plugins.values()):
+            try:
+                plugin.transition(
+                    key, start, actual_finish, stimulus_id=stimulus_id, **kwargs
+                )
+            except Exception:
+                logger.exception("Plugin %r failed in transition", plugin)
+        return recommendations, client_msgs, worker_msgs
+
+    def _transitions(
+        self,
+        recommendations: dict[Key, str],
+        client_msgs: dict,
+        worker_msgs: dict,
+        stimulus_id: str,
+    ) -> None:
+        """Drain recommendations to a fixed point (reference scheduler.py:2045)."""
+        keys: set[Key] = set()
+        recommendations = dict(recommendations)
+        while recommendations:
+            key, finish = recommendations.popitem()
+            keys.add(key)
+            new_recs, new_cmsgs, new_wmsgs = self._transition(key, finish, stimulus_id)
+            recommendations.update(new_recs)
+            _merge_msgs_inplace(client_msgs, new_cmsgs)
+            _merge_msgs_inplace(worker_msgs, new_wmsgs)
+        if self.validate:
+            for key in keys:
+                ts = self.tasks.get(key)
+                if ts is not None:
+                    self.validate_task_state(ts)
+
+    def transitions(self, recommendations: dict[Key, str], stimulus_id: str) -> tuple[dict, dict]:
+        """Public entry: process recommendations, return (client_msgs, worker_msgs)."""
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+        return client_msgs, worker_msgs
+
+    def story(self, *keys_or_stimuli: Key) -> list[tuple]:
+        """Transition log entries touching any of the given keys/stimuli
+        (reference scheduler.py:2915)."""
+        keys = set(keys_or_stimuli)
+        return [
+            t
+            for t in self.transition_log
+            if t[0] in keys or t[4] in keys or keys & set(t[3])
+        ]
+
+    # ------------------------------------------------- transition handlers
+
+    def _transition_released_waiting(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert ts.run_spec is not None
+            assert not ts.waiting_on
+            assert not ts.who_has
+            assert not ts.processing_on
+        recommendations: dict[Key, str] = {}
+        for dts in ts.dependencies:
+            if dts.state == "forgotten":
+                # dependency irrecoverably gone (e.g. scattered data lost)
+                ts.state = "erred"  # pragma: no cover
+                return recommendations, {}, {}
+            if dts.state != "memory":
+                ts.waiting_on.add(dts)
+                dts.waiters.add(ts)
+                if dts.state == "released":
+                    recommendations[dts.key] = "waiting"
+        ts.state = "waiting"
+        self._count_transition(ts, "released", "waiting")
+        if not ts.waiting_on:
+            if self.workers:
+                recommendations[key] = "processing"
+            else:
+                self.unrunnable[ts] = time()
+                ts.state = "no-worker"
+                self._count_transition(ts, "waiting", "no-worker")
+        return recommendations, {}, {}
+
+    def _transition_waiting_processing(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        """Possibly schedule a waiting task (reference scheduler.py:2313)."""
+        ts = self.tasks[key]
+        if self.validate:
+            assert not ts.waiting_on
+            assert not ts.who_has
+            assert not ts.exception_blame
+            assert not ts.processing_on
+        if self.is_rootish(ts):
+            if math_isfinite(self.WORKER_SATURATION) and ts.queueable:
+                if not (ws := self.decide_worker_rootish_queuing_enabled()):
+                    return {ts.key: "queued"}, {}, {}
+            else:
+                if not (ws := self.decide_worker_rootish_queuing_disabled(ts)):
+                    return {ts.key: "no-worker"}, {}, {}
+        else:
+            if not (ws := self.decide_worker_non_rootish(ts)):
+                return {ts.key: "no-worker"}, {}, {}
+        worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
+        self._count_transition(ts, "waiting", "processing")
+        return {}, {}, worker_msgs
+
+    def _transition_waiting_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        recommendations: dict[Key, str] = {}
+        # membership guard: an erred dep already cleared its waiters and must
+        # not be released/resurrected here (reference scheduler.py:2587-2592)
+        for dts in ts.dependencies:
+            if ts in dts.waiters:
+                dts.waiters.discard(ts)
+                if not dts.waiters and not dts.who_wants:
+                    recommendations[dts.key] = "released"
+        ts.waiting_on.clear()
+        ts.state = "released"
+        self._count_transition(ts, "waiting", "released")
+        if not ts.dependents and not ts.who_wants:
+            recommendations[key] = "forgotten"
+        elif not ts.exception_blame and (ts.who_wants or ts.waiters):
+            recommendations[key] = "waiting"
+            for dts in ts.dependencies:
+                dts.waiters.add(ts)
+        else:
+            ts.waiters.clear()  # reference scheduler.py:2602
+        return recommendations, {}, {}
+
+    def _transition_waiting_queued(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert ts not in self.queued
+            assert not self.idle_task_count, (ts, self.idle_task_count)
+        ts.state = "queued"
+        self._count_transition(ts, "waiting", "queued")
+        self.queued.add(ts)
+        return {}, {}, {}
+
+    def _transition_waiting_no_worker(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        ts.state = "no-worker"
+        self._count_transition(ts, "waiting", "no-worker")
+        self.unrunnable[ts] = time()
+        return {}, {}, {}
+
+    def _transition_waiting_memory(
+        self, key: Key, stimulus_id: str, *, nbytes: int | None = None,
+        type: str | None = None, typename: str | None = None, worker: str = "", **kwargs: Any
+    ) -> tuple[dict, dict, dict]:
+        """Data arrived unexpectedly early (e.g. scatter / AMM replica)."""
+        ts = self.tasks[key]
+        ws = self.workers.get(worker)
+        if ws is None:
+            return {}, {}, {}
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        self._remove_from_waiting(ts, recommendations)
+        if nbytes is not None:
+            self.update_nbytes(ts, nbytes)
+        self.add_replica(ts, ws)
+        ts.state = "memory"
+        ts.type = typename or type
+        self._count_transition(ts, "waiting", "memory")
+        self._notify_waiters_task_in_memory(ts, recommendations, client_msgs)
+        return recommendations, client_msgs, {}
+
+    def _transition_queued_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        self.queued.discard(ts)
+        ts.state = "released"
+        self._count_transition(ts, "queued", "released")
+        recommendations: dict[Key, str] = {}
+        self._propagate_released_followup(ts, recommendations)
+        return recommendations, {}, {}
+
+    def _transition_queued_processing(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert not ts.actor, "queued actors not supported"
+        ws = self.decide_worker_rootish_queuing_enabled()
+        if ws is None:
+            return {}, {}, {}  # remain queued
+        self.queued.discard(ts)
+        worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
+        self._count_transition(ts, "queued", "processing")
+        return {}, {}, worker_msgs
+
+    def _transition_processing_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        ws = ts.processing_on
+        if self.validate:
+            assert ws is not None
+            assert not ts.who_has
+            assert not ts.waiting_on
+        worker_msgs: dict = {}
+        if ws is not None and ws.address in self.workers:
+            worker_msgs[ws.address] = [
+                {
+                    "op": "free-keys",
+                    "keys": [key],
+                    "stimulus_id": stimulus_id,
+                }
+            ]
+        self._exit_processing_common(ts)
+        ts.state = "released"
+        self._count_transition(ts, "processing", "released")
+        recommendations: dict[Key, str] = {}
+        self._propagate_released_followup(ts, recommendations)
+        return recommendations, {}, worker_msgs
+
+    def _transition_processing_memory(
+        self,
+        key: Key,
+        stimulus_id: str,
+        *,
+        nbytes: int | None = None,
+        typename: str | None = None,
+        worker: str,
+        startstops: list | None = None,
+        **kwargs: Any,
+    ) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        assert worker
+        ws = ts.processing_on
+        if ws is None or ws.address != worker or self.workers.get(worker) is not ws:
+            # stale or misrouted completion: ignore (reference scheduler.py:2380)
+            logger.debug("Unexpected finished task %s from %s", key, worker)
+            return {}, {}, {}
+        wws = ws
+
+        # update duration statistics (reference scheduler.py:2366 + _observe)
+        if startstops:
+            for startstop in startstops:
+                if startstop.get("action") == "compute":
+                    duration = startstop["stop"] - startstop["start"]
+                    ts.prefix.add_duration(duration)
+                    ts.group.duration += duration
+                    if not ts.group.start:
+                        ts.group.start = startstop["start"]
+                    ts.group.stop = max(ts.group.stop, startstop["stop"])
+
+        self._exit_processing_common(ts)
+        if nbytes is not None:
+            self.update_nbytes(ts, nbytes)
+        self.add_replica(ts, wws)
+        ts.state = "memory"
+        ts.type = typename
+        if typename and ts.group is not None:
+            ts.group.types.add(typename)
+        self._count_transition(ts, "processing", "memory")
+
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        self._notify_waiters_task_in_memory(ts, recommendations, client_msgs)
+        return recommendations, client_msgs, {}
+
+    def _transition_processing_erred(
+        self,
+        key: Key,
+        stimulus_id: str,
+        *,
+        worker: str | None = None,
+        cause: Key | None = None,
+        exception: Any = None,
+        traceback: Any = None,
+        exception_text: str = "",
+        traceback_text: str = "",
+        **kwargs: Any,
+    ) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        failing_ws = ts.processing_on
+        if failing_ws is not None:
+            self._exit_processing_common(ts)
+        if self.validate:
+            assert cause or ts.exception_blame
+        if ts.actor and failing_ws is not None:
+            failing_ws.actors.discard(ts)
+
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+
+        if ts.retries > 0:
+            ts.retries -= 1
+            ts.state = "released"
+            self._count_transition(ts, "processing", "released")
+            recommendations[key] = "waiting"
+            return recommendations, client_msgs, {}
+
+        if exception is not None:
+            ts.exception = exception
+            ts.exception_text = exception_text
+        if traceback is not None:
+            ts.traceback = traceback
+            ts.traceback_text = traceback_text
+        if cause is not None:
+            ts.exception_blame = self.tasks.get(cause)
+        if worker:
+            ts.erred_on.add(worker)
+        blame = ts.exception_blame or ts
+
+        for dts in ts.dependents:
+            dts.exception_blame = blame
+            recommendations[dts.key] = "erred"
+        for dts in ts.dependencies:
+            dts.waiters.discard(ts)
+            if not dts.waiters and not dts.who_wants:
+                recommendations[dts.key] = "released"
+        ts.waiters.clear()
+        ts.state = "erred"
+        self._count_transition(ts, "processing", "erred")
+
+        report_msg = {
+            "op": "task-erred",
+            "key": key,
+            "exception": blame.exception,
+            "traceback": blame.traceback,
+        }
+        for cs in ts.who_wants:
+            client_msgs.setdefault(cs.client_key, []).append(report_msg)
+        self.log_event(
+            "all",
+            {
+                "action": "task-erred",
+                "key": key,
+                "exception": ts.exception_text,
+                "worker": worker,
+            },
+        )
+        return recommendations, client_msgs, {}
+
+    def _transition_released_erred(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert ts.exception_blame
+            assert not ts.who_has
+            assert not ts.waiting_on
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        failure = ts.exception_blame
+        assert failure is not None
+        for dts in ts.dependents:
+            if dts.state not in ("erred", "forgotten"):
+                dts.exception_blame = failure
+                recommendations[dts.key] = "erred"
+        report_msg = {
+            "op": "task-erred",
+            "key": key,
+            "exception": failure.exception,
+            "traceback": failure.traceback,
+        }
+        for cs in ts.who_wants:
+            client_msgs.setdefault(cs.client_key, []).append(report_msg)
+        ts.state = "erred"
+        self._count_transition(ts, "released", "erred")
+        return recommendations, client_msgs, {}
+
+    def _transition_erred_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        ts.exception = None
+        ts.exception_blame = None
+        ts.traceback = None
+        ts.erred_on.clear()
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        for dts in ts.dependents:
+            if dts.state == "erred":
+                recommendations[dts.key] = "waiting"
+        w_msg = {"op": "free-keys", "keys": [key], "stimulus_id": stimulus_id}
+        worker_msgs = {addr: [w_msg] for addr in ts.erred_on if addr in self.workers}
+        report_msg = {"op": "task-retried", "key": key}
+        for cs in ts.who_wants:
+            client_msgs.setdefault(cs.client_key, []).append(report_msg)
+        ts.state = "released"
+        self._count_transition(ts, "erred", "released")
+        return recommendations, client_msgs, worker_msgs
+
+    def _transition_no_worker_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        del self.unrunnable[ts]
+        ts.state = "released"
+        self._count_transition(ts, "no-worker", "released")
+        recommendations: dict[Key, str] = {}
+        self._propagate_released_followup(ts, recommendations)
+        return recommendations, {}, {}
+
+    def _transition_no_worker_processing(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if ws := self.decide_worker_non_rootish(ts):
+            del self.unrunnable[ts]
+            worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
+            self._count_transition(ts, "no-worker", "processing")
+            return {}, {}, worker_msgs
+        return {}, {}, {}
+
+    def _transition_memory_released(
+        self, key: Key, stimulus_id: str, *, safe: bool = False
+    ) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert not ts.waiting_on
+            assert not ts.processing_on
+            if safe:
+                assert not ts.waiters
+        if ts.actor:
+            for ws in ts.who_has:
+                ws.actors.discard(ts)
+            if ts.who_wants:
+                ts.exception_blame = ts
+                ts.exception = "Worker holding Actor was lost"
+                return {ts.key: "erred"}, {}, {}
+
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        # dependents that were waiting on us must go back to waiting
+        for dts in ts.waiters:
+            if dts.state in ("no-worker", "processing", "queued"):
+                recommendations[dts.key] = "waiting"
+            elif dts.state == "waiting":
+                dts.waiting_on.add(ts)
+        # free replicas on all workers
+        freed = [ws.address for ws in ts.who_has]
+        for ws in list(ts.who_has):
+            self.remove_replica(ts, ws)
+        for addr in freed:
+            if addr in self.workers:
+                worker_msgs.setdefault(addr, []).append(
+                    {"op": "free-keys", "keys": [key], "stimulus_id": stimulus_id}
+                )
+        ts.state = "released"
+        self._count_transition(ts, "memory", "released")
+        report_msg = {"op": "lost-data", "key": key}
+        for cs in ts.who_wants:
+            client_msgs.setdefault(cs.client_key, []).append(report_msg)
+        if not ts.run_spec:  # pure data (scatter) — cannot be recomputed
+            recommendations[key] = "forgotten"
+        elif ts.who_wants or ts.waiters:
+            recommendations[key] = "waiting"
+        if recommendations.get(key) == "waiting":
+            for dts in ts.dependencies:
+                dts.waiters.add(ts)
+        return recommendations, client_msgs, worker_msgs
+
+    def _transition_released_forgotten(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert ts.state in ("released", "erred")
+            assert not ts.who_has
+            assert not ts.processing_on
+            assert not ts.waiting_on
+            assert not any(
+                dts.state != "forgotten" for dts in ts.dependents
+            ), (ts, [d for d in ts.dependents if d.state != "forgotten"])
+        recommendations: dict[Key, str] = {}
+        self._propagate_forgotten(ts, recommendations)
+        client_msgs = self._task_erred_or_forgotten_report(ts)
+        self.remove_all_replicas(ts)
+        self._remove_task(ts)
+        return recommendations, client_msgs, {}
+
+    def _transition_memory_forgotten(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        ts = self.tasks[key]
+        if self.validate:
+            assert ts.state == "memory"
+            assert not ts.processing_on
+            assert not ts.waiting_on
+        recommendations: dict[Key, str] = {}
+        worker_msgs: dict = {}
+        for ws in ts.who_has:
+            worker_msgs.setdefault(ws.address, []).append(
+                {"op": "free-keys", "keys": [key], "stimulus_id": stimulus_id}
+            )
+        self._propagate_forgotten(ts, recommendations)
+        client_msgs = self._task_erred_or_forgotten_report(ts)
+        self.remove_all_replicas(ts)
+        self._remove_task(ts)
+        return recommendations, client_msgs, worker_msgs
+
+    # --------------------------------------------- transition helper pieces
+
+    def _count_transition(self, ts: TaskState, start: str, finish: str) -> None:
+        if ts.group is not None:
+            ts.group.states[start] -= 1
+            ts.group.states[finish] += 1
+        if ts.prefix is not None:
+            ts.prefix.state_counts[finish] += 1
+
+    def _propagate_released_followup(self, ts: TaskState, recommendations: dict) -> None:
+        """After a task lands in released: rerun, or forget, or stay."""
+        if not ts.dependents and not ts.who_wants:
+            recommendations[ts.key] = "forgotten"
+        elif not ts.exception_blame and (ts.who_wants or ts.waiters):
+            recommendations[ts.key] = "waiting"
+            for dts in ts.dependencies:
+                dts.waiters.add(ts)
+
+    def _remove_from_waiting(self, ts: TaskState, recommendations: dict) -> None:
+        for dts in ts.waiting_on:
+            dts.waiters.discard(ts)
+            if not dts.waiters and not dts.who_wants:
+                recommendations[dts.key] = "released"
+        ts.waiting_on.clear()
+
+    def _notify_waiters_task_in_memory(
+        self, ts: TaskState, recommendations: dict, client_msgs: dict
+    ) -> None:
+        """Task hit memory: unblock waiters, report to clients, release
+        no-longer-needed dependencies (reference scheduler.py:2366 tail)."""
+        for dts in list(ts.dependents):
+            if ts in dts.waiting_on:
+                dts.waiting_on.discard(ts)
+                if not dts.waiting_on and dts.state == "waiting":
+                    recommendations[dts.key] = "processing"
+        for dts in ts.dependencies:
+            dts.waiters.discard(ts)
+            if not dts.waiters and not dts.who_wants:
+                recommendations[dts.key] = "released"
+        if not ts.waiters and not ts.who_wants:
+            recommendations[ts.key] = "released"
+        else:
+            report_msg = {
+                "op": "key-in-memory",
+                "key": ts.key,
+                "type": ts.type,
+            }
+            for cs in ts.who_wants:
+                client_msgs.setdefault(cs.client_key, []).append(report_msg)
+
+    def _task_erred_or_forgotten_report(self, ts: TaskState) -> dict:
+        client_msgs: dict = {}
+        if ts.who_wants:
+            report_msg = {"op": "cancelled-keys", "keys": [ts.key]}
+            for cs in ts.who_wants:
+                client_msgs.setdefault(cs.client_key, []).append(report_msg)
+        return client_msgs
+
+    def _propagate_forgotten(self, ts: TaskState, recommendations: dict) -> None:
+        self._count_transition(ts, ts.state, "forgotten")
+        ts.state = "forgotten"
+        for dts in ts.dependents:
+            dts.dependencies.discard(ts)
+            dts.waiting_on.discard(ts)
+        ts.dependents.clear()
+        ts.waiters.clear()
+        for dts in ts.dependencies:
+            dts.dependents.discard(ts)
+            dts.waiters.discard(ts)
+            if not dts.dependents and not dts.who_wants:
+                recommendations[dts.key] = "forgotten"
+        ts.dependencies.clear()
+        ts.waiting_on.clear()
+
+    def _remove_task(self, ts: TaskState) -> None:
+        if ts.group is not None:
+            tg = ts.group
+            tg.n_tasks -= 1
+            if tg.n_tasks <= 0:
+                self.task_groups.pop(tg.name, None)
+                if tg.prefix is not None:
+                    tg.prefix.groups.discard(tg)
+        for cs in list(ts.who_wants):
+            cs.wants_what.discard(ts)
+        ts.who_wants.clear()
+        self.tasks.pop(ts.key, None)
+
+    def _exit_processing_common(self, ts: TaskState) -> None:
+        """Remove from processing_on worker and fix occupancy
+        (reference _exit_processing_common scheduler.py:3264)."""
+        ws = ts.processing_on
+        assert ws is not None
+        ts.processing_on = None
+        duration = ws.processing.pop(ts, 0.0)
+        was_long_running = ts in ws.long_running
+        ws.long_running.discard(ts)
+        ws.executing.pop(ts, None)
+        if not was_long_running:
+            self._adjust_occupancy(ws, -duration / max(ws.nthreads, 1))
+        if not ws.processing:
+            self._total_occupancy -= ws.occupancy
+            ws.occupancy = 0.0
+        if ts.resource_restrictions:
+            for r, quantity in ts.resource_restrictions.items():
+                if r in ws.used_resources:
+                    ws.used_resources[r] -= quantity
+        self.check_idle_saturated(ws)
+
+    def _add_to_processing(self, ts: TaskState, ws: WorkerState, stimulus_id: str) -> dict:
+        """Assign ts to ws (reference scheduler.py:3199)."""
+        if self.validate:
+            assert not ts.waiting_on
+            assert not ts.who_has
+            assert not ts.exception_blame
+            assert not ts.processing_on
+            assert ws in self.running, (ws, ts)
+        duration = self.get_task_duration(ts)
+        comm = self.get_comm_cost(ts, ws)
+        ws.processing[ts] = duration + comm
+        ts.processing_on = ws
+        ts.state = "processing"
+        self._adjust_occupancy(ws, (duration + comm) / max(ws.nthreads, 1))
+        if ts.resource_restrictions:
+            for r, quantity in ts.resource_restrictions.items():
+                ws.used_resources[r] = ws.used_resources.get(r, 0) + quantity
+        if ts.actor:
+            ws.actors.add(ts)
+        self.check_idle_saturated(ws)
+        return {ws.address: [self._task_to_msg(ts, stimulus_id)]}
+
+    def _task_to_msg(self, ts: TaskState, stimulus_id: str) -> dict:
+        """Build the compute-task message (reference scheduler.py:3421)."""
+        assert ts.priority is not None
+        return {
+            "op": "compute-task",
+            "key": ts.key,
+            "priority": ts.priority,
+            "stimulus_id": stimulus_id,
+            "who_has": {
+                dts.key: [wws.address for wws in dts.who_has] for dts in ts.dependencies
+            },
+            "nbytes": {dts.key: dts.nbytes for dts in ts.dependencies},
+            "run_spec": ts.run_spec,
+            "duration": self.get_task_duration(ts),
+            "resource_restrictions": ts.resource_restrictions,
+            "actor": ts.actor,
+            "annotations": ts.annotations or {},
+            "span_id": ts.group.span_id if ts.group else None,
+        }
+
+    # ------------------------------------------------------- cost model
+
+    def get_task_duration(self, ts: TaskState) -> float:
+        """Estimated runtime (reference scheduler.py:2986)."""
+        prefix = ts.prefix
+        duration = prefix.duration_average if prefix is not None else -1.0
+        if duration >= 0:
+            return duration
+        if prefix is not None:
+            s = self.unknown_durations.setdefault(prefix.name, set())
+            s.add(ts)
+        return self.UNKNOWN_TASK_DURATION
+
+    def get_comm_cost(self, ts: TaskState, ws: WorkerState) -> float:
+        """Bytes that must move to run ts on ws, over bandwidth
+        (reference scheduler.py:3003)."""
+        if len(ts.dependencies) < 10:
+            deps = [dts for dts in ts.dependencies if ws not in dts.who_has]
+        else:
+            deps = [
+                dts for dts in ts.dependencies.difference(ws.has_what)
+            ]
+        nbytes = sum(dts.get_nbytes() for dts in deps)
+        return nbytes / self.bandwidth
+
+    def worker_objective(self, ts: TaskState, ws: WorkerState) -> tuple:
+        """Lower is better (reference scheduler.py:3131)."""
+        dep_bytes = sum(
+            dts.get_nbytes() for dts in ts.dependencies if ws not in dts.who_has
+        )
+        stack_time = ws.occupancy / max(ws.nthreads, 1) + dep_bytes / self.bandwidth
+        start_time = stack_time + self.get_task_duration(ts)
+        if ts.actor:
+            return (len(ws.actors), start_time, ws.nbytes)
+        return (start_time, ws.nbytes)
+
+    # ------------------------------------------------------- placement
+
+    def is_rootish(self, ts: TaskState) -> bool:
+        """Root-ish: a task in a large group with few deps
+        (reference scheduler.py:2929)."""
+        if ts._rootish is not None:
+            return ts._rootish
+        if ts.resource_restrictions or ts.worker_restrictions or ts.host_restrictions:
+            return False
+        tg = ts.group
+        if tg is None:
+            return False
+        return (
+            len(tg) > self.total_nthreads * 2
+            and len(tg.dependencies) < 5
+            and sum(map(len, tg.dependencies)) < 5
+        )
+
+    def decide_worker_rootish_queuing_disabled(self, ts: TaskState) -> WorkerState | None:
+        """Co-assign sibling root tasks to the same worker
+        (reference scheduler.py:2135)."""
+        assert ts.group is not None
+        tg = ts.group
+        lws = tg.last_worker
+        if not (lws and tg.last_worker_tasks_left and lws.address in self.workers
+                and lws.status == WORKER_STATUS_RUNNING):
+            # pick the least-occupied running worker
+            lws = min(
+                self.running,
+                key=lambda ws: (len(ws.processing) / max(ws.nthreads, 1), ws.nbytes, ws.address),
+                default=None,
+            )
+            if lws is None:
+                return None
+            tg.last_worker_tasks_left = len(tg) // max(len(self.running), 1) or 1
+        tg.last_worker = lws
+        tg.last_worker_tasks_left -= 1
+        if tg.last_worker_tasks_left == 0:
+            tg.last_worker = None
+        return lws
+
+    def decide_worker_rootish_queuing_enabled(self) -> WorkerState | None:
+        """Least-busy idle worker, or None to queue
+        (reference scheduler.py:2195)."""
+        if not self.idle_task_count:
+            return None
+        ws = min(
+            self.idle_task_count,
+            key=lambda ws: (len(ws.processing) / max(ws.nthreads, 1), ws.address),
+        )
+        if self.validate:
+            assert not _worker_full(ws, self.WORKER_SATURATION), (ws, self.WORKER_SATURATION)
+        return ws
+
+    def decide_worker_non_rootish(self, ts: TaskState) -> WorkerState | None:
+        """Place by data locality + occupancy (reference scheduler.py:2247, 8550)."""
+        if not self.running:
+            return None
+        valid_workers = self.valid_workers(ts)
+        if valid_workers is None and len(self.running) < len(self.workers):
+            valid_workers = self.running
+        if self.placement is not None and self.placement.wants(ts):
+            ws = self.placement.decide_worker(self, ts, valid_workers)
+            if ws is not None:
+                return ws
+        return self._decide_worker_locality(ts, valid_workers)
+
+    def _decide_worker_locality(
+        self, ts: TaskState, valid_workers: set[WorkerState] | None
+    ) -> WorkerState | None:
+        """The python oracle for decide_worker (reference scheduler.py:8550)."""
+        assert all(dts.who_has for dts in ts.dependencies), (
+            ts,
+            [d for d in ts.dependencies if not d.who_has],
+        )
+        if ts.actor:
+            candidates = set(self.running)
+        else:
+            candidates = {ws for dts in ts.dependencies for ws in dts.who_has}
+            candidates &= self.running
+        if valid_workers is None:
+            if not candidates:
+                candidates = set(self.running)
+        else:
+            candidates &= valid_workers
+            if not candidates:
+                candidates = valid_workers & self.running
+                if not candidates:
+                    if ts.loose_restrictions:
+                        return self._decide_worker_locality(ts, None)
+                    return None
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return next(iter(candidates))
+        return min(
+            candidates, key=lambda ws: self.worker_objective(ts, ws) + (ws.address,)
+        )
+
+    def valid_workers(self, ts: TaskState) -> set[WorkerState] | None:
+        """Workers satisfying ts's restrictions; None = all
+        (reference scheduler.py:3043)."""
+        if not ts.has_restrictions:
+            return None
+        s: set[WorkerState] | None = None
+        if ts.worker_restrictions:
+            s = {
+                self.workers[addr]
+                for addr in ts.worker_restrictions
+                if addr in self.workers
+            }
+        if ts.host_restrictions:
+            hosts = {
+                ws
+                for ws in self.workers.values()
+                if ws.address.rsplit(":", 1)[0].split("://")[-1] in ts.host_restrictions
+                or str(ws.name) in ts.host_restrictions
+            }
+            s = hosts if s is None else s & hosts
+        if ts.resource_restrictions:
+            res_ok = {
+                ws
+                for ws in self.workers.values()
+                if all(
+                    ws.resources.get(r, 0) - ws.used_resources.get(r, 0) >= q
+                    for r, q in ts.resource_restrictions.items()
+                )
+            }
+            s = res_ok if s is None else s & res_ok
+        return s if s is not None else set()
+
+    # ------------------------------------------------ idle/saturated model
+
+    def check_idle_saturated(self, ws: WorkerState, occ: float | None = None) -> None:
+        """Update the idle/saturated sets (reference scheduler.py:2949)."""
+        if self.total_nthreads == 0 or ws.status == WORKER_STATUS_CLOSED:
+            return
+        if occ is None:
+            occ = ws.occupancy
+        p = len(ws.processing)
+        avg = self.total_occupancy / self.total_nthreads if self.total_nthreads else 0
+
+        idle = self.idle
+        saturated = self.saturated
+        if (p < ws.nthreads or occ < ws.nthreads * avg / 2) and ws.status == WORKER_STATUS_RUNNING:
+            idle[ws.address] = ws
+            saturated.discard(ws)
+        else:
+            idle.pop(ws.address, None)
+            nc = ws.nthreads
+            if p > nc and occ > nc * avg:
+                saturated.add(ws)
+            else:
+                saturated.discard(ws)
+
+        if not _worker_full(ws, self.WORKER_SATURATION) and ws.status == WORKER_STATUS_RUNNING:
+            self.idle_task_count.add(ws)
+        else:
+            self.idle_task_count.discard(ws)
+
+    @property
+    def total_occupancy(self) -> float:
+        return self._total_occupancy
+
+    def _adjust_occupancy(self, ws: WorkerState, delta: float) -> None:
+        ws.occupancy = max(0.0, ws.occupancy + delta)
+        self._total_occupancy = max(0.0, self._total_occupancy + delta)
+
+    def _task_slots_available(self, ws: WorkerState) -> int:
+        """Open slots below the saturation threshold (reference scheduler.py:8762)."""
+        if ws.status != WORKER_STATUS_RUNNING:
+            return 0
+        return max(
+            math_ceil(ws.nthreads * self.WORKER_SATURATION) - len(ws.processing), 0
+        )
+
+    def stimulus_queue_slots_maybe_opened(self, stimulus_id: str) -> dict[Key, str]:
+        """Pop exactly as many queued tasks as there are open slots
+        (reference scheduler.py:4983)."""
+        if not self.queued:
+            return {}
+        slots = sum(self._task_slots_available(ws) for ws in self.idle_task_count)
+        if slots <= 0:
+            return {}
+        return {ts.key: "processing" for ts in list(self.queued.peekn(slots))}
+
+    # ------------------------------------------------------ replica model
+
+    def add_replica(self, ts: TaskState, ws: WorkerState) -> None:
+        """Record that ws holds a replica of ts (reference scheduler.py:4760)."""
+        if ws in ts.who_has:
+            return
+        ws.nbytes += ts.get_nbytes()
+        ws.has_what[ts] = None
+        ts.who_has.add(ws)
+        if len(ts.who_has) == 2:
+            self.replicated_tasks.add(ts)
+
+    def remove_replica(self, ts: TaskState, ws: WorkerState) -> None:
+        ws.nbytes -= ts.get_nbytes()
+        del ws.has_what[ts]
+        ts.who_has.discard(ws)
+        if len(ts.who_has) == 1:
+            self.replicated_tasks.discard(ts)
+
+    def remove_all_replicas(self, ts: TaskState) -> None:
+        nbytes = ts.get_nbytes()
+        for ws in ts.who_has:
+            ws.nbytes -= nbytes
+            del ws.has_what[ts]
+        if len(ts.who_has) > 1:
+            self.replicated_tasks.discard(ts)
+        ts.who_has.clear()
+
+    def update_nbytes(self, ts: TaskState, nbytes: int) -> None:
+        old = ts.get_nbytes() if ts.nbytes >= 0 else 0
+        diff = nbytes - old
+        if ts.group is not None:
+            ts.group.nbytes_total += diff
+        if ts.prefix is not None:
+            ts.prefix.nbytes_total += diff
+        for ws in ts.who_has:
+            ws.nbytes += diff
+        ts.nbytes = nbytes
+
+    # ------------------------------------------------------- events
+
+    def log_event(self, topic: str | Iterable[str], msg: Any) -> None:
+        """Ring-buffered structured events (reference scheduler.py:8244)."""
+        if isinstance(topic, str):
+            topic = [topic]
+        stamp = time()
+        for t in topic:
+            self.events[t].append((stamp, msg))
+            self.event_counts[t] += 1
+
+    # ----------------------------------------------------- stimuli (pure)
+
+    def stimulus_task_finished(
+        self, key: Key, worker: str, stimulus_id: str, **kwargs: Any
+    ) -> tuple[dict, dict]:
+        """A worker reported a finished task (reference scheduler.py:5025)."""
+        ts = self.tasks.get(key)
+        if ts is None or ts.state in ("released", "forgotten", "erred"):
+            # stale completion for a cancelled task: tell worker to drop it
+            wmsg = {
+                "op": "free-keys",
+                "keys": [key],
+                "stimulus_id": stimulus_id,
+            }
+            return {}, {worker: [wmsg]}
+        if ts.state == "memory":
+            ws = self.workers.get(worker)
+            if ws is not None and ws not in ts.who_has:
+                self.add_replica(ts, ws)
+            return {}, {}
+        if ts.state != "processing":
+            return {}, {}
+        ts.metadata = kwargs.pop("metadata", None) or ts.metadata
+        recs, cmsgs, wmsgs = self._transition(
+            key, "memory", stimulus_id, worker=worker, **kwargs
+        )
+        client_msgs: dict = dict(cmsgs)
+        worker_msgs: dict = dict(wmsgs)
+        self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+        recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+        self._transitions(recs2, client_msgs, worker_msgs, stimulus_id)
+        return client_msgs, worker_msgs
+
+    def stimulus_task_erred(
+        self,
+        key: Key,
+        worker: str,
+        stimulus_id: str,
+        *,
+        exception: Any = None,
+        traceback: Any = None,
+        exception_text: str = "",
+        traceback_text: str = "",
+        **kwargs: Any,
+    ) -> tuple[dict, dict]:
+        """A worker reported a task failure (reference scheduler.py:5106)."""
+        ts = self.tasks.get(key)
+        if ts is None or ts.state != "processing":
+            return {}, {}
+        if ts.processing_on is None or ts.processing_on.address != worker:
+            return {}, {}
+        recs = {}
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        r, c, w = self._transition(
+            key,
+            "erred",
+            stimulus_id,
+            cause=key,
+            exception=exception,
+            traceback=traceback,
+            exception_text=exception_text,
+            traceback_text=traceback_text,
+            worker=worker,
+            **kwargs,
+        )
+        _merge_msgs_inplace(client_msgs, c)
+        _merge_msgs_inplace(worker_msgs, w)
+        self._transitions(r, client_msgs, worker_msgs, stimulus_id)
+        recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+        self._transitions(recs2, client_msgs, worker_msgs, stimulus_id)
+        return client_msgs, worker_msgs
+
+    def stimulus_retry(self, keys: Iterable[Key], stimulus_id: str) -> tuple[dict, dict]:
+        """Re-run erred tasks (reference scheduler.py:5131)."""
+        roots: set[Key] = set()
+        for key in keys:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            # walk up the blame chain to the root cause
+            seen: set[Key] = set()
+            while ts.exception_blame is not None and ts.exception_blame is not ts:
+                if ts.key in seen:
+                    break
+                seen.add(ts.key)
+                ts = ts.exception_blame
+            if ts.state == "erred":
+                roots.add(ts.key)
+        # "waiting" routes erred -> released -> waiting (reference :5131)
+        return self.transitions({k: "waiting" for k in roots}, stimulus_id)
+
+    # ------------------------------------------------ worker lifecycle
+
+    def add_worker_state(
+        self,
+        address: str,
+        *,
+        nthreads: int = 1,
+        memory_limit: int = 0,
+        name: object = None,
+        resources: dict[str, float] | None = None,
+        server_id: str | None = None,
+    ) -> WorkerState:
+        """Register a worker (pure part of reference add_worker :4308)."""
+        if address in self.workers:
+            return self.workers[address]
+        ws = WorkerState(
+            address, nthreads=nthreads, memory_limit=memory_limit, name=name,
+            server_id=server_id,
+        )
+        if resources:
+            ws.resources.update(resources)
+            ws.used_resources = dict.fromkeys(resources, 0)
+            for r, q in resources.items():
+                self.resources[r][address] = q
+        self.workers[address] = ws
+        self.aliases[ws.name] = address
+        self.running.add(ws)
+        self.total_nthreads += nthreads
+        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self.check_idle_saturated(ws)
+        if self.placement is not None:
+            self.placement.on_add_worker(self, ws)
+        return ws
+
+    def bulk_schedule_unrunnable_after_adding_worker(self, ws: WorkerState) -> dict[Key, str]:
+        """Try no-worker tasks on the new worker (reference scheduler.py:3173)."""
+        runnable = [
+            ts
+            for ts in self.unrunnable
+            if (valid := self.valid_workers(ts)) is None or ws in valid
+        ]
+        runnable.sort(key=lambda ts: (ts.priority, ts.key), reverse=True)
+        return {ts.key: "processing" for ts in runnable}
+
+    def remove_worker_state(
+        self,
+        address: str,
+        *,
+        stimulus_id: str,
+        safe: bool = False,
+        expected: bool = False,
+    ) -> tuple[dict, dict]:
+        """Unregister a worker, rescheduling its work and releasing its
+        replicas (pure part of reference remove_worker :5180).
+
+        Returns (client_msgs, worker_msgs) after draining all resulting
+        transitions.  Lineage recomputation happens here: tasks whose only
+        replica lived on the dead worker are recommended back through
+        released -> waiting and will be recomputed from run_spec.
+        """
+        ws = self.workers.get(address)
+        if ws is None:
+            return {}, {}
+        del self.workers[address]
+        self.aliases.pop(ws.name, None)
+        ws.status = WORKER_STATUS_CLOSED
+        self.running.discard(ws)
+        self.idle.pop(ws.address, None)
+        self.idle_task_count.discard(ws)
+        self.saturated.discard(ws)
+        self.total_nthreads -= ws.nthreads
+        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self._total_occupancy -= ws.occupancy
+        ws.occupancy = 0.0
+        for r in ws.resources:
+            self.resources[r].pop(address, None)
+        if self.placement is not None:
+            self.placement.on_remove_worker(self, ws)
+
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+
+        for ts in list(ws.processing):
+            k = ts.key
+            recommendations[k] = "released"
+            if not safe:
+                ts.suspicious += 1
+                ts.erred_on.add(address)
+                if ts.suspicious > self.ALLOWED_FAILURES:
+                    del recommendations[k]
+                    e = KilledWorker(
+                        task=k, last_worker=address, allowed_failures=self.ALLOWED_FAILURES
+                    )
+                    r, c, w = self._transition(
+                        k,
+                        "erred",
+                        stimulus_id,
+                        exception=e,
+                        cause=k,
+                        exception_text=str(e),
+                        worker=address,
+                    )
+                    recommendations.update(r)
+                    _merge_msgs_inplace(client_msgs, c)
+                    _merge_msgs_inplace(worker_msgs, w)
+                    self.log_event(
+                        "all",
+                        {"action": "killed-worker", "key": k, "worker": address},
+                    )
+
+        for ts in list(ws.has_what):
+            self.remove_replica(ts, ws)
+            if not ts.who_has:
+                if ts.run_spec:
+                    recommendations[ts.key] = "released"
+                else:  # pure data, lost for good
+                    recommendations[ts.key] = "forgotten"
+
+        self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+        # the departed worker must not receive queued messages
+        worker_msgs.pop(address, None)
+        recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+        self._transitions(recs2, client_msgs, worker_msgs, stimulus_id)
+        return client_msgs, worker_msgs
+
+    # ------------------------------------------------ client lifecycle
+
+    def add_client_state(self, client: str) -> ClientState:
+        cs = self.clients.get(client)
+        if cs is None:
+            cs = self.clients[client] = ClientState(client)
+        return cs
+
+    def client_desires_keys(self, keys: Iterable[Key], client: str) -> None:
+        cs = self.add_client_state(client)
+        for key in keys:
+            ts = self.tasks.get(key)
+            if ts is None:
+                ts = self.new_task(key, None, "released")
+            ts.who_wants.add(cs)
+            cs.wants_what.add(ts)
+
+    def client_releases_keys(
+        self, keys: Iterable[Key], client: str, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """Client no longer wants these keys (reference scheduler.py:5441)."""
+        cs = self.clients.get(client)
+        if cs is None:
+            return {}, {}
+        recommendations: dict[Key, str] = {}
+        for key in keys:
+            ts = self.tasks.get(key)
+            if ts is None or ts not in cs.wants_what:
+                continue
+            cs.wants_what.discard(ts)
+            ts.who_wants.discard(cs)
+            if not ts.who_wants:
+                if not ts.dependents:
+                    recommendations[key] = "forgotten"
+                elif not ts.waiters:
+                    recommendations[key] = "released"
+        return self.transitions(recommendations, stimulus_id)
+
+    def remove_client_state(self, client: str, stimulus_id: str) -> tuple[dict, dict]:
+        cs = self.clients.pop(client, None)
+        if cs is None:
+            return {}, {}
+        return self.client_releases_keys(
+            [ts.key for ts in cs.wants_what], client, stimulus_id
+        )
+
+    # ------------------------------------------------------ graph intake
+
+    def update_graph_core(
+        self,
+        tasks: dict[Key, Any],
+        dependencies: dict[Key, set[Key]],
+        keys: Iterable[Key],
+        *,
+        client: str | None = None,
+        priorities: dict[Key, tuple] | None = None,
+        user_priority: int | dict[Key, int] = 0,
+        generation: int = 0,
+        annotations_by_key: dict[Key, dict] | None = None,
+        retries: int | dict[Key, int] | None = None,
+        actors: bool | list[Key] = False,
+        stimulus_id: str = "update-graph",
+    ) -> tuple[dict, dict]:
+        """Materialize a graph into TaskStates and kick off transitions.
+
+        Pure equivalent of the reference's update_graph -> _generate_taskstates
+        -> _set_priorities -> transitions (scheduler.py:4662-4981).
+        ``tasks`` maps key -> run_spec (TaskSpec or literal); ``priorities``
+        are static ranks from graph.order (computed by the caller, possibly
+        offloaded).
+        """
+        if priorities is None:
+            from distributed_tpu.graph.order import order as order_fn
+
+            priorities = {
+                k: (r,) for k, r in order_fn(dependencies).items()
+            }
+
+        touched: list[TaskState] = []
+        for key, spec in tasks.items():
+            ts = self.tasks.get(key)
+            if ts is None:
+                ts = self.new_task(key, spec, "released")
+            elif ts.run_spec is None and spec is not None:
+                ts.run_spec = spec
+            touched.append(ts)
+
+        for key, deps in dependencies.items():
+            ts = self.tasks[key]
+            for dkey in deps:
+                dts = self.tasks.get(dkey)
+                if dts is None:
+                    dts = self.new_task(dkey, None, "released")
+                ts.add_dependency(dts)
+
+        for ts in touched:
+            key = ts.key
+            if ts.priority is None and key in priorities:
+                rank = priorities[key]
+                upri = (
+                    user_priority.get(key, 0)
+                    if isinstance(user_priority, dict)
+                    else user_priority
+                )
+                ts.priority = (-upri, generation) + tuple(rank)
+            if isinstance(retries, dict):
+                ts.retries = retries.get(key, 0)
+            elif retries:
+                ts.retries = retries
+            if annotations_by_key and key in annotations_by_key:
+                ts.annotations = dict(annotations_by_key[key])
+                ann = ts.annotations
+                if "workers" in ann:
+                    w = ann["workers"]
+                    ts.worker_restrictions = set([w] if isinstance(w, str) else w)
+                if "allow_other_workers" in ann:
+                    ts.loose_restrictions = bool(ann["allow_other_workers"])
+                if "resources" in ann:
+                    ts.resource_restrictions = dict(ann["resources"])
+                if "retries" in ann:
+                    ts.retries = ann["retries"]
+                if "priority" in ann and ts.priority is not None:
+                    ts.priority = (-ann["priority"],) + ts.priority[1:]
+            if (actors is True) or (isinstance(actors, list) and key in actors):
+                ts.actor = True
+
+        # fill priorities for tasks created only as dependencies
+        for ts in self.tasks.values():
+            if ts.priority is None:
+                ts.priority = (0, generation, 0)
+
+        if client is not None:
+            self.client_desires_keys(keys, client)
+
+        recommendations: dict[Key, str] = {}
+        # seed transitions from the leaves up: released tasks that are
+        # wanted (directly or transitively) go to waiting
+        wanted: set[TaskState] = set()
+        stack = [self.tasks[k] for k in keys if k in self.tasks]
+        while stack:
+            ts = stack.pop()
+            if ts in wanted:
+                continue
+            wanted.add(ts)
+            stack.extend(ts.dependencies)
+        # highest priority inserted last: _transitions pops LIFO, so the
+        # best-priority task reaches decide_worker first
+        for ts in sorted(wanted, key=lambda ts: ts.priority or (0,), reverse=True):
+            if ts.state == "released" and ts.run_spec is not None:
+                recommendations[ts.key] = "waiting"
+        client_msgs, worker_msgs = self.transitions(recommendations, stimulus_id)
+        # immediately report already-completed keys
+        for key in keys:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            if ts.state == "memory":
+                for cs in ts.who_wants:
+                    client_msgs.setdefault(cs.client_key, []).append(
+                        {"op": "key-in-memory", "key": key, "type": ts.type}
+                    )
+            elif ts.state == "erred":
+                for cs in ts.who_wants:
+                    client_msgs.setdefault(cs.client_key, []).append(
+                        {
+                            "op": "task-erred",
+                            "key": key,
+                            "exception": ts.exception,
+                            "traceback": ts.traceback,
+                        }
+                    )
+        return client_msgs, worker_msgs
+
+    # -------------------------------------------------------- validation
+
+    def validate_task_state(self, ts: TaskState) -> None:
+        """Invariant check for one task (reference scheduler.py:8596)."""
+        try:
+            assert ts.state in ALL_TASK_STATES or ts.state == "forgotten", ts
+
+            for dts in ts.waiting_on:
+                assert dts.state != "memory", (ts, dts)
+                assert ts in dts.waiters, (ts, dts)
+            for dts in ts.dependencies:
+                assert ts in dts.dependents, (ts, dts)
+            for dts in ts.waiters:
+                assert dts.state in ("waiting", "queued", "processing", "no-worker"), (
+                    ts,
+                    dts,
+                    dts.state,
+                )
+                assert ts in dts.waiting_on or ts.state == "memory", (ts, dts)
+
+            if ts.state == "waiting":
+                assert not ts.who_has, ts
+                assert not ts.processing_on, ts
+            elif ts.state == "queued":
+                assert ts in self.queued, ts
+                assert not ts.processing_on, ts
+                assert not ts.who_has, ts
+            elif ts.state == "processing":
+                assert ts.processing_on, ts
+                assert ts in ts.processing_on.processing, ts
+                assert not ts.waiting_on, ts
+                assert not ts.who_has, ts
+            elif ts.state == "memory":
+                assert ts.who_has, ts
+                assert not ts.processing_on, ts
+                assert not ts.waiting_on, ts
+                for ws in ts.who_has:
+                    assert ts in ws.has_what, (ts, ws)
+            elif ts.state == "no-worker":
+                assert ts in self.unrunnable, ts
+                assert not ts.processing_on, ts
+                assert not ts.who_has, ts
+            elif ts.state == "erred":
+                assert not ts.processing_on, ts
+                assert not ts.who_has, ts
+            elif ts.state == "released":
+                assert not ts.processing_on, ts
+                assert not ts.who_has, ts
+                assert not ts.waiting_on, ts
+            assert (ts.processing_on is not None) == (ts.state == "processing"), ts
+            assert bool(ts.who_has) == (ts.state == "memory"), ts
+        except AssertionError as e:
+            raise InvalidTaskState(
+                f"invalid task state for {ts!r} ({ts.state}): {e}"
+            ) from e
+
+    def validate_worker_state(self, ws: WorkerState) -> None:
+        for ts in ws.has_what:
+            assert ws in ts.who_has, (ws, ts)
+        for ts in ws.processing:
+            assert ts.processing_on is ws, (ws, ts)
+            assert ts.state == "processing", (ws, ts)
+
+    def validate_state(self) -> None:
+        """Full invariant check (reference scheduler.py:5544)."""
+        for ts in self.tasks.values():
+            self.validate_task_state(ts)
+        for ws in self.workers.values():
+            self.validate_worker_state(ws)
+        for ts in self.queued:
+            assert ts.state == "queued", ts
+        for ts in self.unrunnable:
+            assert ts.state == "no-worker", ts
+
+
+WORKER_STATUS_CLOSED = "closed"
+
+
+def _worker_full(ws: WorkerState, saturation_factor: float) -> bool:
+    """Is ws at/above its saturation threshold (reference scheduler.py:8750)."""
+    if saturation_factor == float("inf"):
+        return False
+    return len(ws.processing) >= max(math_ceil(ws.nthreads * saturation_factor), 1)
+
+
+def _merge_msgs(a: dict, b: dict) -> dict:
+    out = {k: list(v) for k, v in a.items()}
+    _merge_msgs_inplace(out, b)
+    return out
+
+
+def _merge_msgs_inplace(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        dst.setdefault(k, []).extend(v)
+
+
+import math  # noqa: E402
+
+math_isfinite = math.isfinite
+math_ceil = math.ceil

@@ -57,6 +57,18 @@ class HeapSet(Generic[T]):
         self._data.discard(el)
         if not self._data:
             self._heap.clear()
+        elif len(self._heap) > 2 * len(self._data) + 64:
+            self._prune()
+
+    def _prune(self) -> None:
+        """Drop stale heap entries so churn doesn't pin discarded elements."""
+        live = [
+            entry
+            for entry in self._heap
+            if (el := entry[2]()) is not None and el in self._data
+        ]
+        heapq.heapify(live)
+        self._heap = live
 
     def remove(self, el: T) -> None:
         if el not in self._data:
@@ -91,18 +103,13 @@ class HeapSet(Generic[T]):
         return el
 
     def peekn(self, n: int) -> Iterator[T]:
-        """Iterate over the n smallest elements without removing them."""
+        """Iterate over the n smallest elements without removing them.
+
+        Non-destructive: the caller may add/discard freely while iterating.
+        """
         if n <= 0 or not self._data:
-            return
-        popped = []
-        try:
-            for _ in range(min(n, len(self._data))):
-                el = self.pop()
-                popped.append(el)
-                yield el
-        finally:
-            for el in popped:
-                self.add(el)
+            return iter(())
+        return iter(heapq.nsmallest(n, list(self._data), key=self.key))
 
     def sorted(self) -> list[T]:
         return sorted(self._data, key=self.key)

@@ -102,7 +102,11 @@ async def offload(fn: Callable[..., T], *args: Any, **kwargs: Any) -> T:
 
 def sync(loop: asyncio.AbstractEventLoop, coro_fn, *args, timeout=None, **kwargs):
     """Run ``coro_fn(*args, **kwargs)`` on ``loop`` from a foreign thread."""
-    if asyncio.get_event_loop_policy()._local.__dict__.get("_loop") is loop:  # pragma: no cover
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if running is loop:
         raise RuntimeError("sync() called from the event loop thread")
     coro = coro_fn(*args, **kwargs)
     if timeout is not None:
@@ -118,24 +122,30 @@ class LoopRunner:
         self.loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
+        self._lock = threading.Lock()
 
     def start(self) -> asyncio.AbstractEventLoop:
-        if self.loop is not None and self._thread and self._thread.is_alive():
+        with self._lock:
+            if self.loop is not None and self._thread and self._thread.is_alive():
+                return self.loop
+
+            self._started.clear()
+
+            def run() -> None:
+                loop = asyncio.new_event_loop()
+                self.loop = loop
+                asyncio.set_event_loop(loop)
+                self._started.set()
+                loop.run_forever()
+                loop.close()
+
+            self._thread = threading.Thread(
+                target=run, name="DTPU-LoopRunner", daemon=True
+            )
+            self._thread.start()
+            self._started.wait()
+            assert self.loop is not None
             return self.loop
-
-        def run() -> None:
-            loop = asyncio.new_event_loop()
-            self.loop = loop
-            asyncio.set_event_loop(loop)
-            self._started.set()
-            loop.run_forever()
-            loop.close()
-
-        self._thread = threading.Thread(target=run, name="DTPU-LoopRunner", daemon=True)
-        self._thread.start()
-        self._started.wait()
-        assert self.loop is not None
-        return self.loop
 
     def run_sync(self, coro_fn, *args, timeout=None, **kwargs):
         loop = self.start()

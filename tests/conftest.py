@@ -17,7 +17,6 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import asyncio  # noqa: E402
-import contextlib  # noqa: E402
 import functools  # noqa: E402
 
 import pytest  # noqa: E402
@@ -46,9 +45,3 @@ def gen_test(timeout: float = 60):
     return decorator
 
 
-@contextlib.contextmanager
-def config_override(**kwargs):
-    from distributed_tpu import config
-
-    with config.set(**kwargs):
-        yield

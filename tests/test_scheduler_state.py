@@ -1,0 +1,389 @@
+"""Tier-1 deterministic tests of the scheduler state machine (no IO).
+
+Mirrors the reference's pure state-machine test strategy (SURVEY.md §4): drive
+SchedulerState with synthetic stimuli, assert on returned messages, run
+validate_state() as the oracle after every step.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distributed_tpu.exceptions import KilledWorker
+from distributed_tpu.graph import Graph, TaskRef, TaskSpec
+from distributed_tpu.scheduler.state import SchedulerState
+
+
+class Sim:
+    """Simulate a cluster around a SchedulerState: collect compute-task
+    messages per worker and let the test 'finish' them."""
+
+    def __init__(self, nworkers: int = 2, nthreads: int = 1, **kwargs):
+        kwargs.setdefault("validate", True)
+        kwargs.setdefault("transition_counter_max", 50_000)
+        self.state = SchedulerState(**kwargs)
+        self.inbox: dict[str, list[dict]] = {}
+        self.client_inbox: dict[str, list[dict]] = {}
+        self.addrs = []
+        for i in range(nworkers):
+            addr = f"tcp://127.0.0.1:{10000 + i}"
+            self.addrs.append(addr)
+            ws = self.state.add_worker_state(
+                addr, nthreads=nthreads, memory_limit=2**30, name=f"w{i}"
+            )
+            self.state.check_idle_saturated(ws)
+
+    def submit_graph(self, g: Graph, keys, client="client-1", **kwargs):
+        g.validate()
+        deps = g.dependencies()
+        cmsgs, wmsgs = self.state.update_graph_core(
+            dict(g.tasks), deps, list(keys), client=client, **kwargs
+        )
+        self._route(cmsgs, wmsgs)
+        self.state.validate_state()
+
+    def _route(self, cmsgs, wmsgs):
+        for addr, msgs in wmsgs.items():
+            self.inbox.setdefault(addr, []).extend(msgs)
+        for client, msgs in cmsgs.items():
+            self.client_inbox.setdefault(client, []).extend(msgs)
+
+    def pending_computes(self, addr=None):
+        out = []
+        for a, msgs in self.inbox.items():
+            if addr is not None and a != addr:
+                continue
+            for m in msgs:
+                if m["op"] == "compute-task":
+                    out.append((a, m))
+        return out
+
+    def finish(self, addr, key, nbytes=8, duration=0.01):
+        """Simulate worker `addr` completing `key`."""
+        # drop the compute msg from the inbox
+        self.inbox[addr] = [
+            m for m in self.inbox.get(addr, []) if not (m["op"] == "compute-task" and m["key"] == key)
+        ]
+        cmsgs, wmsgs = self.state.stimulus_task_finished(
+            key,
+            addr,
+            "sim-finish",
+            nbytes=nbytes,
+            typename="int",
+            startstops=[{"action": "compute", "start": 0.0, "stop": duration}],
+        )
+        self._route(cmsgs, wmsgs)
+        self.state.validate_state()
+
+    def fail(self, addr, key, exc=None):
+        cmsgs, wmsgs = self.state.stimulus_task_erred(
+            key,
+            addr,
+            "sim-err",
+            exception=exc or ValueError("boom"),
+            exception_text="boom",
+        )
+        self._route(cmsgs, wmsgs)
+        self.state.validate_state()
+
+    def run_to_completion(self, max_steps=100_000):
+        """Greedily execute every pending compute message."""
+        steps = 0
+        while True:
+            pending = self.pending_computes()
+            if not pending:
+                break
+            addr, msg = pending[0]
+            self.finish(addr, msg["key"])
+            steps += 1
+            assert steps < max_steps, "simulation did not converge"
+
+    def client_reports(self, client="client-1", op=None):
+        msgs = self.client_inbox.get(client, [])
+        if op:
+            msgs = [m for m in msgs if m["op"] == op]
+        return msgs
+
+
+def linear_graph(n=4):
+    g = Graph()
+    g["t0"] = TaskSpec(lambda: 1)
+    for i in range(1, n):
+        g[f"t{i}"] = TaskSpec(lambda x: x + 1, (TaskRef(f"t{i-1}"),))
+    return g
+
+
+def test_single_task_lifecycle():
+    sim = Sim(nworkers=1)
+    g = Graph({"x": TaskSpec(lambda: 42)})
+    sim.submit_graph(g, ["x"])
+    ts = sim.state.tasks["x"]
+    assert ts.state == "processing"
+    pending = sim.pending_computes()
+    assert len(pending) == 1
+    addr, msg = pending[0]
+    assert msg["key"] == "x"
+    assert msg["priority"] is not None
+    sim.finish(addr, "x", nbytes=100)
+    assert ts.state == "memory"
+    assert ts.nbytes == 100
+    assert [m["op"] for m in sim.client_reports()] == ["key-in-memory"]
+
+
+def test_linear_chain_executes_in_order():
+    sim = Sim(nworkers=2)
+    sim.submit_graph(linear_graph(4), ["t3"])
+    # only the root is runnable
+    assert sim.state.tasks["t0"].state == "processing"
+    assert sim.state.tasks["t1"].state == "waiting"
+    sim.run_to_completion()
+    assert sim.state.tasks["t3"].state == "memory"
+    # intermediates released once consumed (only t3 is wanted)
+    for k in ("t0", "t1", "t2"):
+        assert sim.state.tasks[k].state in ("released", "forgotten"), k
+
+
+def test_diamond_dependencies():
+    g = Graph()
+    g["a"] = TaskSpec(lambda: 1)
+    g["b"] = TaskSpec(lambda x: x + 1, (TaskRef("a"),))
+    g["c"] = TaskSpec(lambda x: x * 2, (TaskRef("a"),))
+    g["d"] = TaskSpec(lambda x, y: x + y, (TaskRef("b"), TaskRef("c")))
+    sim = Sim(nworkers=2)
+    sim.submit_graph(g, ["d"])
+    sim.run_to_completion()
+    assert sim.state.tasks["d"].state == "memory"
+    reports = sim.client_reports(op="key-in-memory")
+    assert [m["key"] for m in reports] == ["d"]
+
+
+def test_data_locality_placement():
+    """Non-rootish tasks go where their (large) dependencies live."""
+    sim = Sim(nworkers=2)
+    g = Graph()
+    g["big"] = TaskSpec(lambda: b"x")
+    g["consume"] = TaskSpec(lambda x: len(x), (TaskRef("big"),))
+    sim.submit_graph(g, ["consume"])
+    (addr, _), = sim.pending_computes()
+    sim.finish(addr, "big", nbytes=10_000_000)
+    ts = sim.state.tasks["consume"]
+    assert ts.state == "processing"
+    assert ts.processing_on.address == addr  # placed on the data
+
+
+def test_fanout_spreads_across_workers():
+    """A wide embarrassingly-parallel map should use all workers."""
+    sim = Sim(nworkers=4, nthreads=2)
+    g = Graph()
+    for i in range(64):
+        g[f"task-{i}"] = TaskSpec(lambda i=i: i)
+    sim.submit_graph(g, list(g.tasks))
+    # with queuing: exactly ceil(2*1.1)=3 slots per worker processing
+    processing_per_worker = {
+        addr: len(sim.state.workers[addr].processing) for addr in sim.addrs
+    }
+    assert all(v > 0 for v in processing_per_worker.values()), processing_per_worker
+    assert len(sim.state.queued) == 64 - sum(processing_per_worker.values())
+    sim.run_to_completion()
+    assert all(sim.state.tasks[k].state == "memory" for k in g.tasks)
+    assert len(sim.state.queued) == 0
+
+
+def test_queued_tasks_flow_as_slots_open():
+    sim = Sim(nworkers=1, nthreads=1)
+    g = Graph()
+    for i in range(10):
+        g[f"t-{i}"] = TaskSpec(lambda i=i: i)
+    sim.submit_graph(g, list(g.tasks))
+    # saturation 1.1 * 1 thread -> ceil = 2 in processing
+    assert sum(1 for t in sim.state.tasks.values() if t.state == "processing") == 2
+    assert len(sim.state.queued) == 8
+    sim.run_to_completion()
+    assert all(t.state == "memory" for t in sim.state.tasks.values())
+
+
+def test_error_propagates_to_dependents():
+    sim = Sim(nworkers=1)
+    g = linear_graph(3)
+    sim.submit_graph(g, ["t2"])
+    sim.fail(sim.addrs[0], "t0")
+    assert sim.state.tasks["t0"].state == "erred"
+    assert sim.state.tasks["t1"].state == "erred"
+    assert sim.state.tasks["t2"].state == "erred"
+    errs = sim.client_reports(op="task-erred")
+    assert any(m["key"] == "t2" for m in errs)
+
+
+def test_retries_rerun_task():
+    sim = Sim(nworkers=1)
+    g = Graph({"flaky": TaskSpec(lambda: 1)})
+    sim.submit_graph(g, ["flaky"], retries=1)
+    sim.fail(sim.addrs[0], "flaky")
+    ts = sim.state.tasks["flaky"]
+    assert ts.state == "processing"  # rescheduled
+    assert ts.retries == 0
+    sim.finish(sim.addrs[0], "flaky")
+    assert ts.state == "memory"
+
+
+def test_stimulus_retry_after_err():
+    sim = Sim(nworkers=1)
+    g = linear_graph(2)
+    sim.submit_graph(g, ["t1"])
+    sim.fail(sim.addrs[0], "t0")
+    assert sim.state.tasks["t1"].state == "erred"
+    cmsgs, wmsgs = sim.state.stimulus_retry(["t1"], "retry-1")
+    sim._route(cmsgs, wmsgs)
+    sim.state.validate_state()
+    assert sim.state.tasks["t0"].state == "processing"
+    sim.run_to_completion()
+    assert sim.state.tasks["t1"].state == "memory"
+
+
+def test_worker_loss_recomputes_lineage():
+    """Lineage-based recomputation: losing the only replica reruns tasks."""
+    sim = Sim(nworkers=2)
+    g = linear_graph(3)
+    sim.submit_graph(g, ["t2"])
+    # run t0 and t1, then kill the worker holding their outputs
+    sim.run_to_completion()
+    assert sim.state.tasks["t2"].state == "memory"
+    holder = next(iter(sim.state.tasks["t2"].who_has))
+    cmsgs, wmsgs = sim.state.remove_worker_state(
+        holder.address, stimulus_id="sim-remove"
+    )
+    sim._route(cmsgs, wmsgs)
+    sim.state.validate_state()
+    ts = sim.state.tasks["t2"]
+    # t2 must be recomputed from lineage on the remaining worker
+    assert ts.state in ("processing", "waiting")
+    assert any(m["op"] == "lost-data" for m in sim.client_reports())
+    sim.run_to_completion()
+    assert ts.state == "memory"
+
+
+def test_killed_worker_after_allowed_failures():
+    sim = Sim(nworkers=4)
+    g = Graph({"poison": TaskSpec(lambda: 1)})
+    sim.submit_graph(g, ["poison"])
+    for round_ in range(sim.state.ALLOWED_FAILURES + 1):
+        ts = sim.state.tasks["poison"]
+        assert ts.state == "processing", round_
+        addr = ts.processing_on.address
+        cmsgs, wmsgs = sim.state.remove_worker_state(addr, stimulus_id=f"kill-{round_}")
+        sim._route(cmsgs, wmsgs)
+        sim.state.validate_state()
+    ts = sim.state.tasks["poison"]
+    assert ts.state == "erred"
+    assert isinstance(ts.exception, KilledWorker)
+
+
+def test_client_release_forgets_chain():
+    sim = Sim(nworkers=1)
+    g = linear_graph(3)
+    sim.submit_graph(g, ["t2"])
+    sim.run_to_completion()
+    cmsgs, wmsgs = sim.state.client_releases_keys(["t2"], "client-1", "rel-1")
+    sim._route(cmsgs, wmsgs)
+    assert sim.state.tasks == {}  # whole chain forgotten
+    # worker told to free the data
+    frees = [m for m in sim.inbox[sim.addrs[0]] if m["op"] == "free-keys"]
+    assert any("t2" in m["keys"] for m in frees)
+
+
+def test_no_worker_tasks_schedule_on_join():
+    sim = Sim(nworkers=0)
+    g = Graph({"x": TaskSpec(lambda: 1)})
+    sim.submit_graph(g, ["x"])
+    assert sim.state.tasks["x"].state == "no-worker"
+    ws = sim.state.add_worker_state("tcp://127.0.0.1:20000", nthreads=1)
+    recs = sim.state.bulk_schedule_unrunnable_after_adding_worker(ws)
+    cmsgs, wmsgs = sim.state.transitions(recs, "join-1")
+    sim._route(cmsgs, wmsgs)
+    sim.state.validate_state()
+    assert sim.state.tasks["x"].state == "processing"
+    sim.addrs.append(ws.address)
+    sim.finish(ws.address, "x")
+    assert sim.state.tasks["x"].state == "memory"
+
+
+def test_worker_restrictions():
+    sim = Sim(nworkers=3)
+    g = Graph({"pinned": TaskSpec(lambda: 1)})
+    target = sim.addrs[2]
+    sim.submit_graph(
+        g, ["pinned"], annotations_by_key={"pinned": {"workers": [target]}}
+    )
+    ts = sim.state.tasks["pinned"]
+    assert ts.state == "processing"
+    assert ts.processing_on.address == target
+
+
+def test_resource_restrictions():
+    sim = Sim(nworkers=2)
+    # only worker 1 has the GPU resource
+    ws1 = sim.state.workers[sim.addrs[1]]
+    ws1.resources["GPU"] = 1
+    ws1.used_resources["GPU"] = 0
+    sim.state.resources["GPU"][sim.addrs[1]] = 1
+    g = Graph({"gpu-task": TaskSpec(lambda: 1)})
+    sim.submit_graph(
+        g, ["gpu-task"], annotations_by_key={"gpu-task": {"resources": {"GPU": 1}}}
+    )
+    ts = sim.state.tasks["gpu-task"]
+    assert ts.processing_on.address == sim.addrs[1]
+    assert ws1.used_resources["GPU"] == 1
+    sim.finish(sim.addrs[1], "gpu-task")
+    assert ws1.used_resources["GPU"] == 0
+
+
+def test_rootish_coassignment_without_queuing():
+    """With queuing disabled, sibling root tasks batch onto the same worker."""
+    from distributed_tpu import config
+
+    with config.set({"scheduler.worker-saturation": "inf"}):
+        sim = Sim(nworkers=4)
+        g = Graph()
+        for i in range(40):
+            g[f"root-{i}"] = TaskSpec(lambda i=i: i)
+        sim.submit_graph(g, list(g.tasks))
+        per_worker = [len(ws.processing) for ws in sim.state.workers.values()]
+        # all processing immediately (no queue), roughly balanced blocks
+        assert sum(per_worker) == 40
+        assert len(sim.state.queued) == 0
+        assert max(per_worker) <= 40  # sanity
+        sim.run_to_completion()
+
+
+def test_transition_log_and_story():
+    sim = Sim(nworkers=1)
+    g = Graph({"x": TaskSpec(lambda: 1)})
+    sim.submit_graph(g, ["x"])
+    sim.finish(sim.addrs[0], "x")
+    story = sim.state.story("x")
+    transitions = [(t[1], t[2]) for t in story]
+    assert ("released", "waiting") in transitions
+    assert ("waiting", "processing") in transitions
+    assert ("processing", "memory") in transitions
+
+
+def test_duration_learning():
+    sim = Sim(nworkers=1)
+    g = Graph({"inc-1": TaskSpec(lambda: 1), "inc-2": TaskSpec(lambda: 2)})
+    sim.submit_graph(g, list(g.tasks))
+    sim.finish(sim.addrs[0], "inc-1", duration=2.0)
+    prefix = sim.state.task_prefixes["inc"]
+    assert prefix.duration_average == pytest.approx(2.0)
+    sim.finish(sim.addrs[0], "inc-2", duration=1.0)
+    assert prefix.duration_average == pytest.approx(1.5)
+
+
+def test_occupancy_tracking():
+    sim = Sim(nworkers=2)
+    g = Graph()
+    for i in range(4):
+        g[f"t-{i}"] = TaskSpec(lambda: 1)
+    sim.submit_graph(g, list(g.tasks))
+    assert sim.state.total_occupancy > 0
+    sim.run_to_completion()
+    assert sim.state.total_occupancy == pytest.approx(0.0, abs=1e-9)
