@@ -1,0 +1,305 @@
+"""Batched worker-placement kernels — the JAX co-processor for the scheduler.
+
+The reference decides placement one task at a time in Python:
+``decide_worker`` (scheduler.py:8550) takes ``min(candidates,
+key=worker_objective)`` where ``worker_objective`` (scheduler.py:3131) is
+
+    start_time = occupancy[w]/nthreads[w] + missing_dep_bytes[t,w]/bandwidth
+    key        = (start_time, worker_nbytes[w])
+
+i.e. O(W) python tuple comparisons per task, O(T*W) for a graph intake.
+
+Here the same objective is a dense cost matrix on the TPU:
+
+    cost[t, w] = occupancy[w]/nthreads[w] + missing[t, w]/bandwidth + duration[t]
+
+with ``missing[t, w]`` computed from the batch's dependency edge list by one
+segment-sum (MXU/VPU-friendly, no per-task python), and the argmin fused by
+XLA.  Sequential semantics (each assignment bumps the chosen worker's
+occupancy before the next task decides) are preserved with ``lax.scan`` over
+the batch — each scan step is vectorized over all workers.
+
+Everything is static-shaped: callers pad batches to bucket sizes
+(``pad_to_bucket``) so steady-state operation never recompiles.
+
+Data model (all jnp arrays):
+  worker axis W:  nthreads i32[W], occupancy f32[W], nbytes f32[W],
+                  running bool[W]
+  batch axis B:   duration f32[B], valid bool[B] (padding mask)
+  edge list E:    edge_task i32[E] (batch row), edge_dep i32[E] (dep slot),
+                  valid edges marked by edge_task < B
+  dep table D:    dep_bytes f32[D], has bool[D, W] (replica matrix)
+
+Tie-breaking matches the python oracle: (cost, worker_nbytes, worker_index),
+all compared in float32 (the oracle in scheduler.state uses python floats;
+parity tests pin both to float32 inputs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+F32_INF = jnp.float32(jnp.inf)
+
+
+class WorkerArrays(NamedTuple):
+    """SoA mirror of the scheduler-side WorkerStates (reference
+    scheduler.py:406) for one kernel invocation."""
+
+    nthreads: jax.Array  # i32[W]
+    occupancy: jax.Array  # f32[W]
+    nbytes: jax.Array  # f32[W]
+    running: jax.Array  # bool[W]
+
+    @property
+    def nworkers(self) -> int:
+        return self.nthreads.shape[0]
+
+
+class PlacementBatch(NamedTuple):
+    """One batch of ready tasks to place."""
+
+    duration: jax.Array  # f32[B] estimated runtime per task
+    valid: jax.Array  # bool[B] padding mask
+    edge_task: jax.Array  # i32[E] batch row per dependency edge
+    edge_dep: jax.Array  # i32[E] dep-table slot per edge
+    dep_bytes: jax.Array  # f32[D]
+    has: jax.Array  # bool[D, W] replica matrix
+    restrict: jax.Array | None = None  # bool[B, W] allowed workers, or None
+
+
+def pad_to_bucket(n: int, buckets=(32, 128, 512, 2048, 8192, 32768)) -> int:
+    """Round n up to a compile bucket so jit caches stay warm."""
+    for b in buckets:
+        if n <= b:
+            return b
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+def missing_bytes_matrix(batch: PlacementBatch) -> jax.Array:
+    """missing[t, w] = sum of dep_bytes over deps of t not replicated on w.
+
+    One [E, W] elementwise product + segment-sum — the vectorized
+    equivalent of the python loops in worker_objective/get_comm_cost
+    (reference scheduler.py:3131,3003).
+    """
+    B = batch.duration.shape[0]
+    # f32[E, W]: bytes the edge contributes if w lacks the dep
+    not_has = ~batch.has[batch.edge_dep]  # bool[E, W]
+    contrib = batch.dep_bytes[batch.edge_dep][:, None] * not_has
+    return jax.ops.segment_sum(contrib, batch.edge_task, num_segments=B)
+
+
+def candidate_mask(batch: PlacementBatch, workers: WorkerArrays) -> jax.Array:
+    """valid[t, w]: which workers may run task t.
+
+    Mirrors decide_worker's candidate narrowing (reference scheduler.py:8550):
+    prefer holders of dependencies; fall back to all running workers when no
+    dependency holder is running; intersect with restrictions.
+    """
+    B = batch.duration.shape[0]
+    # segment_max fills empty segments (tasks with no deps) with INT32_MIN,
+    # so compare > 0 rather than casting to bool
+    holder = (
+        jax.ops.segment_max(
+            batch.has[batch.edge_dep].astype(jnp.int32),
+            batch.edge_task,
+            num_segments=B,
+        )
+        > 0
+    )  # bool[B, W]: w holds >= 1 dep of t
+    holder &= workers.running[None, :]
+    has_any_holder = holder.any(axis=1, keepdims=True)
+    cand = jnp.where(has_any_holder, holder, workers.running[None, :])
+    if batch.restrict is not None:
+        restricted = cand & batch.restrict
+        any_restricted = restricted.any(axis=1, keepdims=True)
+        r_and_running = batch.restrict & workers.running[None, :]
+        cand = jnp.where(
+            any_restricted,
+            restricted,
+            r_and_running,
+        )
+    return cand
+
+
+def _ordered_cost(cost: jax.Array, wnbytes: jax.Array, valid: jax.Array) -> jax.Array:
+    """Compose (cost, nbytes, index) into one comparable f64-ish key.
+
+    We avoid argmin ties diverging from the oracle by lexicographic
+    reduction: pick min cost, then among ~equal costs min nbytes, then min
+    index.  Implemented as two masked argmin passes (exact, no epsilon).
+    """
+    big = jnp.where(valid, cost, F32_INF)
+    best = big.min(axis=-1, keepdims=True)
+    tied = (big == best) & valid
+    nb = jnp.where(tied, wnbytes, F32_INF)
+    best_nb = nb.min(axis=-1, keepdims=True)
+    tied2 = tied & (nb == best_nb)
+    W = cost.shape[-1]
+    idx = jnp.arange(W, dtype=jnp.int32)
+    return jnp.where(tied2, idx, W).min(axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("sequential",))
+def decide_workers(
+    workers: WorkerArrays,
+    batch: PlacementBatch,
+    bandwidth: float,
+    sequential: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Place a batch of ready tasks.
+
+    Returns (assignment i32[B] — worker index or -1 for unplaceable,
+    new_occupancy f32[W]).
+
+    ``sequential=True`` preserves the reference's one-at-a-time semantics
+    via lax.scan: task i sees occupancy updated by tasks 0..i-1 (each step
+    vectorized over workers).  ``sequential=False`` is a single parallel
+    argmin over the full cost matrix followed by one occupancy segment-sum —
+    faster, used for huge rootish waves where assignments are spread anyway.
+    """
+    missing = missing_bytes_matrix(batch)  # f32[B, W]
+    cand = candidate_mask(batch, workers)  # bool[B, W]
+    cand &= batch.valid[:, None]
+    xfer = missing / jnp.float32(bandwidth)  # f32[B, W]
+    nthreads = jnp.maximum(workers.nthreads, 1).astype(jnp.float32)
+
+    if not sequential:
+        cost = workers.occupancy[None, :] / nthreads[None, :] + xfer
+        assignment = _ordered_cost(cost, workers.nbytes[None, :], cand)
+        unplaceable = ~cand.any(axis=1)
+        assignment = jnp.where(unplaceable | ~batch.valid, -1, assignment)
+        delta = (batch.duration + jnp.take_along_axis(
+            xfer, jnp.maximum(assignment, 0)[:, None], axis=1
+        )[:, 0]) / nthreads[jnp.maximum(assignment, 0)]
+        delta = jnp.where(assignment >= 0, delta, 0.0)
+        occ = workers.occupancy + jax.ops.segment_sum(
+            delta, jnp.maximum(assignment, 0), num_segments=workers.nworkers
+        )
+        return assignment, occ
+
+    def step(occ, inputs):
+        xfer_t, cand_t, dur_t, valid_t = inputs
+        cost = occ / nthreads + xfer_t
+        w = _ordered_cost(cost[None, :], workers.nbytes[None, :], cand_t[None, :])[0]
+        ok = cand_t.any() & valid_t
+        w = jnp.where(ok, w, -1)
+        delta = jnp.where(ok, (dur_t + xfer_t[jnp.maximum(w, 0)]) / nthreads[jnp.maximum(w, 0)], 0.0)
+        occ = occ.at[jnp.maximum(w, 0)].add(delta)
+        return occ, w
+
+    occ, assignment = lax.scan(
+        step, workers.occupancy, (xfer, cand, batch.duration, batch.valid)
+    )
+    return assignment, occ
+
+
+@functools.partial(jax.jit, static_argnames=("max_tasks",))
+def place_rootish(
+    n_tasks: jax.Array,  # i32[] number of (identical) rootish tasks to place
+    workers: WorkerArrays,
+    max_tasks: int = 0,
+) -> jax.Array:
+    """Balanced block assignment for a wave of rootish sibling tasks.
+
+    The reference co-assigns siblings in contiguous blocks per worker
+    (tg.last_worker / last_worker_tasks_left, scheduler.py:2135-2187) so that
+    their reductions stay local.  Vectorized: capacity-weighted contiguous
+    blocks over running workers, no per-task python.
+
+    Returns i32[max_tasks] worker index per task (-1 past n_tasks).
+    """
+    W = workers.nworkers
+    threads = jnp.where(workers.running, jnp.maximum(workers.nthreads, 1), 0)
+    total = jnp.maximum(threads.sum(), 1)
+    # block sizes proportional to thread counts (ceil), contiguous prefix sums
+    quota = (n_tasks * threads + total - 1) // total  # i32[W]
+    ends = jnp.cumsum(quota)
+    starts = ends - quota
+    t = jnp.arange(max_tasks, dtype=jnp.int32)
+    # task i -> the worker whose [start, end) contains i
+    w_of_t = jnp.searchsorted(ends, t, side="right").astype(jnp.int32)
+    w_of_t = jnp.clip(w_of_t, 0, W - 1)
+    valid = (t < n_tasks) & workers.running[w_of_t]
+    return jnp.where(valid, w_of_t, -1)
+
+
+@jax.jit
+def occupancy_after_finish(
+    occupancy: jax.Array,  # f32[W]
+    nthreads: jax.Array,  # i32[W]
+    finished_worker: jax.Array,  # i32[F] worker index per finished task (-1 pad)
+    finished_duration: jax.Array,  # f32[F] booked duration per finished task
+) -> jax.Array:
+    """Batched occupancy release on task completion (the device analogue of
+    _exit_processing_common, reference scheduler.py:3264)."""
+    W = occupancy.shape[0]
+    delta = jnp.where(
+        finished_worker >= 0,
+        finished_duration / jnp.maximum(nthreads, 1).astype(jnp.float32)[
+            jnp.maximum(finished_worker, 0)
+        ],
+        0.0,
+    )
+    dec = jax.ops.segment_sum(delta, jnp.maximum(finished_worker, 0), num_segments=W)
+    return jnp.maximum(occupancy - dec, 0.0)
+
+
+# ----------------------------------------------------------------- helpers
+
+def build_batch_arrays(
+    durations: np.ndarray,
+    edges: tuple[np.ndarray, np.ndarray],
+    dep_bytes: np.ndarray,
+    has: np.ndarray,
+    restrict: np.ndarray | None = None,
+    bucket: bool = True,
+) -> PlacementBatch:
+    """Host-side packing of a placement batch with padding to buckets."""
+    B = len(durations)
+    Bp = pad_to_bucket(B) if bucket else B
+    edge_task, edge_dep = edges
+    E = len(edge_task)
+    Ep = pad_to_bucket(max(E, 1)) if bucket else max(E, 1)
+    D = len(dep_bytes)
+    # always leave >= 1 spare zero-byte dep slot for padding edges
+    Dp = pad_to_bucket(D + 1) if bucket else D + 1
+    W = has.shape[1] if has.ndim == 2 else 1
+
+    dur = np.zeros(Bp, np.float32)
+    dur[:B] = durations
+    valid = np.zeros(Bp, bool)
+    valid[:B] = True
+    # pad edges: row 0, spare dep slot with dep_bytes == 0 -> contribute nothing
+    et = np.zeros(Ep, np.int32)
+    ed = np.full(Ep, D, np.int32)
+    et[:E] = edge_task
+    ed[:E] = edge_dep
+    db = np.zeros(Dp, np.float32)
+    db[:D] = dep_bytes
+    hs = np.zeros((Dp, W), bool)
+    if has.size:
+        hs[:D] = has
+    rs = None
+    if restrict is not None:
+        rs = np.ones((Bp, W), bool)
+        rs[:B] = restrict
+    return PlacementBatch(
+        duration=jnp.asarray(dur),
+        valid=jnp.asarray(valid),
+        edge_task=jnp.asarray(et),
+        edge_dep=jnp.asarray(ed),
+        dep_bytes=jnp.asarray(db),
+        has=jnp.asarray(hs),
+        restrict=None if rs is None else jnp.asarray(rs),
+    )

@@ -8,13 +8,18 @@ jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Hard override: this box pins JAX_PLATFORMS=axon (the real TPU) and a
+# sitecustomize.py imports jax in every process, so env vars are too late —
+# use jax.config.update, which works as long as no backend is initialized
+# yet.  Tests run on a virtual 8-device CPU mesh (jax_num_cpu_devices is the
+# supported mechanism on jax 0.9; the XLA_FLAGS host-device-count is ignored).
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import asyncio  # noqa: E402
 import functools  # noqa: E402
