@@ -11,7 +11,7 @@ i.e. O(W) python tuple comparisons per task, O(T*W) for a graph intake.
 
 Here the same objective is a dense cost matrix on the TPU:
 
-    cost[t, w] = occupancy[w]/nthreads[w] + missing[t, w]/bandwidth + duration[t]
+    cost[t, w] = occupancy[w]/nthreads[w] + missing[t, w]/bandwidth
 
 with ``missing[t, w]`` computed from the batch's dependency edge list by one
 segment-sum (MXU/VPU-friendly, no per-task python), and the argmin fused by
@@ -179,9 +179,11 @@ def decide_workers(
         assignment = _ordered_cost(cost, workers.nbytes[None, :], cand)
         unplaceable = ~cand.any(axis=1)
         assignment = jnp.where(unplaceable | ~batch.valid, -1, assignment)
-        delta = (batch.duration + jnp.take_along_axis(
+        # occupancy is raw seconds of queued work (divide by nthreads only
+        # at compare time, reference scheduler.py:3140)
+        delta = batch.duration + jnp.take_along_axis(
             xfer, jnp.maximum(assignment, 0)[:, None], axis=1
-        )[:, 0]) / nthreads[jnp.maximum(assignment, 0)]
+        )[:, 0]
         delta = jnp.where(assignment >= 0, delta, 0.0)
         occ = workers.occupancy + jax.ops.segment_sum(
             delta, jnp.maximum(assignment, 0), num_segments=workers.nworkers
@@ -194,7 +196,7 @@ def decide_workers(
         w = _ordered_cost(cost[None, :], workers.nbytes[None, :], cand_t[None, :])[0]
         ok = cand_t.any() & valid_t
         w = jnp.where(ok, w, -1)
-        delta = jnp.where(ok, (dur_t + xfer_t[jnp.maximum(w, 0)]) / nthreads[jnp.maximum(w, 0)], 0.0)
+        delta = jnp.where(ok, dur_t + xfer_t[jnp.maximum(w, 0)], 0.0)
         occ = occ.at[jnp.maximum(w, 0)].add(delta)
         return occ, w
 
@@ -242,15 +244,10 @@ def occupancy_after_finish(
     finished_duration: jax.Array,  # f32[F] booked duration per finished task
 ) -> jax.Array:
     """Batched occupancy release on task completion (the device analogue of
-    _exit_processing_common, reference scheduler.py:3264)."""
+    _exit_processing_common, reference scheduler.py:3264).  Occupancy is raw
+    seconds of queued work — no division by nthreads here."""
     W = occupancy.shape[0]
-    delta = jnp.where(
-        finished_worker >= 0,
-        finished_duration / jnp.maximum(nthreads, 1).astype(jnp.float32)[
-            jnp.maximum(finished_worker, 0)
-        ],
-        0.0,
-    )
+    delta = jnp.where(finished_worker >= 0, finished_duration, 0.0)
     dec = jax.ops.segment_sum(delta, jnp.maximum(finished_worker, 0), num_segments=W)
     return jnp.maximum(occupancy - dec, 0.0)
 

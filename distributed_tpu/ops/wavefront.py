@@ -77,7 +77,6 @@ class GraphArrays(NamedTuple):
         ob[:T] = out_bytes
         # heaviest dependency per consumer (host-side, one pass)
         heavy = np.full(Tp, -1, np.int64)
-        heavy_bytes = np.zeros(Tp, np.float32)
         dep_total = np.zeros(Tp, np.float32)
         src_bytes = ob[edges_src]
         np.add.at(dep_total, edges_dst, src_bytes)
@@ -89,7 +88,6 @@ class GraphArrays(NamedTuple):
             first = np.ones(E, bool)
             first[1:] = dst_sorted[1:] != dst_sorted[:-1]
             heavy[dst_sorted[first]] = edges_src[order][first]
-            heavy_bytes[dst_sorted[first]] = src_bytes[order][first]
 
         dur = np.zeros(Tp, np.float32)
         dur[:T] = durations

@@ -28,10 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tpu.ops.placement import WorkerArrays, PlacementBatch
 
-try:  # jax >= 0.7
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from jax import shard_map  # jax >= 0.7 (this repo targets jax 0.9)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:

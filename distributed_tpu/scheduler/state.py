@@ -1116,7 +1116,7 @@ class SchedulerState:
         ws.long_running.discard(ts)
         ws.executing.pop(ts, None)
         if not was_long_running:
-            self._adjust_occupancy(ws, -duration / max(ws.nthreads, 1))
+            self._adjust_occupancy(ws, -duration)
         if not ws.processing:
             self._total_occupancy -= ws.occupancy
             ws.occupancy = 0.0
@@ -1139,7 +1139,9 @@ class SchedulerState:
         ws.processing[ts] = duration + comm
         ts.processing_on = ws
         ts.state = "processing"
-        self._adjust_occupancy(ws, (duration + comm) / max(ws.nthreads, 1))
+        # occupancy is booked in raw seconds of queued work; consumers divide
+        # by nthreads once at compare time (reference scheduler.py:3140)
+        self._adjust_occupancy(ws, duration + comm)
         if ts.resource_restrictions:
             for r, quantity in ts.resource_restrictions.items():
                 ws.used_resources[r] = ws.used_resources.get(r, 0) + quantity

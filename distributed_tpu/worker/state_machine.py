@@ -1,0 +1,1244 @@
+"""Worker state machine — pure, deterministic, sans-IO.
+
+The data-plane mirror of the reference's ``worker_state_machine.py``: a
+``WorkerState`` holds every task the scheduler has told this worker about and
+moves it through the states
+
+    released -> waiting -> {fetch -> flight -> memory | missing}
+                        -> {ready | constrained} -> executing -> memory
+                                                -> long-running
+    (any) -> cancelled/resumed -> released/forgotten, error, rescheduled
+
+via ``handle_stimulus(event) -> [Instructions]`` (reference wsm.py:1330):
+events are frozen dataclasses fed by the networked shell; instructions are
+what the shell must do (run a task, gather dependencies from a peer, send a
+message to the scheduler).  No asyncio, no sockets, no clocks — which makes
+every distributed race deterministically reproducible in tests (reference
+test strategy, SURVEY.md §4 tier 1).
+
+Scheduling-within-worker mirrors the reference:
+- ``ready``/``constrained`` priority heaps; ``_ensure_computing``
+  (wsm.py:1726) fills ``nthreads`` slots;
+- per-peer ``data_needed`` heaps; ``_ensure_communicating`` (wsm.py:1531)
+  batches fetches <= ``transfer.message-bytes-limit`` per peer and
+  <= ``connections.incoming`` concurrent peers, skipping busy/in-flight
+  peers (wsm.py:1600).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from distributed_tpu import config
+from distributed_tpu.exceptions import InvalidTaskState, InvalidTransition
+from distributed_tpu.utils import HeapSet
+
+logger = logging.getLogger("distributed_tpu.worker.state")
+
+Key = str
+
+TASK_STATES = (
+    "released",
+    "waiting",
+    "fetch",
+    "flight",
+    "missing",
+    "ready",
+    "constrained",
+    "executing",
+    "long-running",
+    "memory",
+    "cancelled",
+    "resumed",
+    "rescheduled",
+    "error",
+    "forgotten",
+)
+
+READY_STATES = frozenset({"ready", "constrained"})
+PROCESSING_STATES = frozenset({"waiting", "ready", "constrained", "executing", "long-running"})
+FETCH_STATES = frozenset({"fetch", "flight"})
+
+
+class WTaskState:
+    """Worker-side task record (reference wsm.py:TaskState)."""
+
+    __slots__ = (
+        "key",
+        "run_spec",
+        "state",
+        "previous",
+        "next",
+        "priority",
+        "dependencies",
+        "dependents",
+        "waiting_for_data",
+        "waiters",
+        "who_has",
+        "coming_from",
+        "nbytes",
+        "duration",
+        "resource_restrictions",
+        "exception",
+        "traceback",
+        "exception_text",
+        "traceback_text",
+        "actor",
+        "done",
+        "attempt",
+        "span_id",
+        "annotations",
+        "stimulus_id",
+    )
+
+    def __init__(self, key: Key, run_spec: Any = None, priority: tuple = ()):
+        self.key = key
+        self.run_spec = run_spec
+        self.state = "released"
+        self.previous: str | None = None  # for cancelled/resumed
+        self.next: str | None = None
+        self.priority = priority
+        self.dependencies: set[WTaskState] = set()
+        self.dependents: set[WTaskState] = set()
+        self.waiting_for_data: set[WTaskState] = set()
+        self.waiters: set[WTaskState] = set()
+        self.who_has: set[str] = set()
+        self.coming_from: str | None = None
+        self.nbytes = 0
+        self.duration: float = -1
+        self.resource_restrictions: dict[str, float] = {}
+        self.exception: Any = None
+        self.traceback: Any = None
+        self.exception_text = ""
+        self.traceback_text = ""
+        self.actor = False
+        self.done = False
+        self.attempt = 0
+        self.span_id: str | None = None
+        self.annotations: dict = {}
+        self.stimulus_id = ""
+
+    def __repr__(self) -> str:
+        return f"<WTaskState {self.key!r} {self.state}>"
+
+    def __hash__(self) -> int:
+        return hash(self.key)
+
+
+# --------------------------------------------------------------------- events
+
+
+@dataclass(frozen=True)
+class StateMachineEvent:
+    stimulus_id: str
+
+    @classmethod
+    def dummy(cls, stimulus_id: str = "dummy", **kwargs: Any) -> "StateMachineEvent":
+        return cls(stimulus_id=stimulus_id, **kwargs)
+
+
+@dataclass(frozen=True)
+class ComputeTaskEvent(StateMachineEvent):
+    """Scheduler asks this worker to run a task (reference wsm.py:738)."""
+
+    key: Key
+    run_spec: Any = None
+    priority: tuple = ()
+    who_has: dict[Key, list[str]] = field(default_factory=dict)
+    nbytes: dict[Key, int] = field(default_factory=dict)
+    duration: float = 0.5
+    resource_restrictions: dict[str, float] = field(default_factory=dict)
+    actor: bool = False
+    annotations: dict = field(default_factory=dict)
+    span_id: str | None = None
+
+    @classmethod
+    def dummy(cls, key: Key = "x", stimulus_id: str = "dummy", **kwargs: Any):
+        kwargs.setdefault("run_spec", _DummySpec())
+        return cls(stimulus_id=stimulus_id, key=key, **kwargs)
+
+
+class _DummySpec:
+    def substitute(self, data):
+        return (lambda: None), (), {}
+
+
+@dataclass(frozen=True)
+class ExecuteSuccessEvent(StateMachineEvent):
+    key: Key = ""
+    value: Any = None
+    start: float = 0.0
+    stop: float = 0.0
+    nbytes: int = 0
+    type: str | None = None
+
+
+@dataclass(frozen=True)
+class ExecuteFailureEvent(StateMachineEvent):
+    key: Key = ""
+    exception: Any = None
+    traceback: Any = None
+    exception_text: str = ""
+    traceback_text: str = ""
+    start: float = 0.0
+    stop: float = 0.0
+
+
+@dataclass(frozen=True)
+class RescheduleEvent(StateMachineEvent):
+    key: Key = ""
+
+
+@dataclass(frozen=True)
+class LongRunningEvent(StateMachineEvent):
+    """Task called secede() (reference worker.py:2799)."""
+
+    key: Key = ""
+    compute_duration: float = 0.0
+
+
+@dataclass(frozen=True)
+class GatherDepSuccessEvent(StateMachineEvent):
+    worker: str = ""
+    data: dict[Key, Any] = field(default_factory=dict)
+    total_nbytes: int = 0
+
+
+@dataclass(frozen=True)
+class GatherDepBusyEvent(StateMachineEvent):
+    worker: str = ""
+    keys: tuple = ()
+
+
+@dataclass(frozen=True)
+class GatherDepNetworkFailureEvent(StateMachineEvent):
+    worker: str = ""
+    keys: tuple = ()
+
+
+@dataclass(frozen=True)
+class GatherDepFailureEvent(StateMachineEvent):
+    """Deserialization or other local error while receiving."""
+
+    worker: str = ""
+    keys: tuple = ()
+    exception: Any = None
+    traceback: Any = None
+
+
+@dataclass(frozen=True)
+class FreeKeysEvent(StateMachineEvent):
+    keys: tuple = ()
+
+
+@dataclass(frozen=True)
+class RemoveReplicasEvent(StateMachineEvent):
+    keys: tuple = ()
+
+
+@dataclass(frozen=True)
+class AcquireReplicasEvent(StateMachineEvent):
+    """AMM asks this worker to fetch replicas (reference wsm.py)."""
+
+    who_has: dict[Key, list[str]] = field(default_factory=dict)
+    nbytes: dict[Key, int] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class StealRequestEvent(StateMachineEvent):
+    key: Key = ""
+
+
+@dataclass(frozen=True)
+class UpdateDataEvent(StateMachineEvent):
+    """Client scattered data directly to this worker."""
+
+    data: dict[Key, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class PauseEvent(StateMachineEvent):
+    pass
+
+
+@dataclass(frozen=True)
+class UnpauseEvent(StateMachineEvent):
+    pass
+
+
+@dataclass(frozen=True)
+class RetryBusyWorkerEvent(StateMachineEvent):
+    worker: str = ""
+
+
+@dataclass(frozen=True)
+class FindMissingEvent(StateMachineEvent):
+    pass
+
+
+@dataclass(frozen=True)
+class RefreshWhoHasEvent(StateMachineEvent):
+    who_has: dict[Key, list[str]] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------- instructions
+
+
+@dataclass(frozen=True)
+class Instruction:
+    stimulus_id: str
+
+
+@dataclass(frozen=True)
+class Execute(Instruction):
+    key: Key = ""
+
+
+@dataclass(frozen=True)
+class GatherDep(Instruction):
+    worker: str = ""
+    to_gather: tuple = ()
+    total_nbytes: int = 0
+
+
+@dataclass(frozen=True)
+class RetryBusyWorkerLater(Instruction):
+    worker: str = ""
+
+
+@dataclass(frozen=True)
+class SendMessageToScheduler(Instruction):
+    pass
+
+    def to_dict(self) -> dict:
+        d = {
+            k: getattr(self, k)
+            for k in self.__dataclass_fields__
+        }
+        d["op"] = self.op  # type: ignore[attr-defined]
+        return d
+
+
+@dataclass(frozen=True)
+class TaskFinishedMsg(SendMessageToScheduler):
+    op = "task-finished"
+    key: Key = ""
+    nbytes: int = 0
+    typename: str | None = None
+    startstops: tuple = ()
+    metadata: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class TaskErredMsg(SendMessageToScheduler):
+    op = "task-erred"
+    key: Key = ""
+    exception: Any = None
+    traceback: Any = None
+    exception_text: str = ""
+    traceback_text: str = ""
+    startstops: tuple = ()
+
+
+@dataclass(frozen=True)
+class ReleaseWorkerDataMsg(SendMessageToScheduler):
+    op = "release-worker-data"
+    key: Key = ""
+
+
+@dataclass(frozen=True)
+class RescheduleMsg(SendMessageToScheduler):
+    op = "reschedule"
+    key: Key = ""
+
+
+@dataclass(frozen=True)
+class LongRunningMsg(SendMessageToScheduler):
+    op = "long-running"
+    key: Key = ""
+    compute_duration: float = 0.0
+
+
+@dataclass(frozen=True)
+class AddKeysMsg(SendMessageToScheduler):
+    op = "add-keys"
+    keys: tuple = ()
+
+
+@dataclass(frozen=True)
+class StealResponseMsg(SendMessageToScheduler):
+    op = "steal-response"
+    key: Key = ""
+    state: str | None = None
+
+
+@dataclass(frozen=True)
+class MissingDataMsg(SendMessageToScheduler):
+    op = "missing-data"
+    key: Key = ""
+    errant_worker: str = ""
+
+
+@dataclass(frozen=True)
+class RequestRefreshWhoHasMsg(SendMessageToScheduler):
+    op = "request-refresh-who-has"
+    keys: tuple = ()
+
+
+Instructions = list  # list[Instruction]
+Recs = dict  # dict[WTaskState, str]
+
+
+class WorkerState:
+    """Pure worker state (reference worker_state_machine.py:1060)."""
+
+    def __init__(
+        self,
+        *,
+        nthreads: int = 1,
+        address: str = "",
+        data: dict | None = None,
+        resources: dict[str, float] | None = None,
+        validate: bool | None = None,
+        transfer_incoming_count_limit: int | None = None,
+        transfer_message_bytes_limit: int | None = None,
+    ):
+        self.address = address
+        self.nthreads = nthreads
+        self.data: dict[Key, Any] = data if data is not None else {}
+        self.tasks: dict[Key, WTaskState] = {}
+        self.ready: HeapSet[WTaskState] = HeapSet(key=lambda ts: ts.priority)
+        self.constrained: deque[WTaskState] = deque()
+        self.executing: set[WTaskState] = set()
+        self.long_running: set[WTaskState] = set()
+        self.in_flight_tasks: set[WTaskState] = set()
+        self.missing_dep_flight: set[WTaskState] = set()
+        # fetch queues: per-peer heap of tasks to pull
+        self.data_needed: defaultdict[str, HeapSet[WTaskState]] = defaultdict(
+            lambda: HeapSet(key=lambda ts: ts.priority)
+        )
+        self.in_flight_workers: dict[str, set[Key]] = {}
+        self.busy_workers: set[str] = set()
+        self.has_what: defaultdict[str, set[Key]] = defaultdict(set)
+        self.actors: dict[Key, Any] = {}
+        self.total_resources = dict(resources or {})
+        self.available_resources = dict(resources or {})
+        self.running = True  # False when paused
+        self.transfer_incoming_count = 0
+        self.transfer_incoming_bytes = 0
+        self.transfer_incoming_count_limit = (
+            transfer_incoming_count_limit
+            if transfer_incoming_count_limit is not None
+            else config.get("worker.connections.incoming")
+        )
+        self.transfer_message_bytes_limit = (
+            transfer_message_bytes_limit
+            if transfer_message_bytes_limit is not None
+            else config.parse_bytes(config.get("worker.transfer.message-bytes-limit"))
+        )
+        self.validate = (
+            validate if validate is not None else config.get("worker.validate")
+        )
+        self.nbytes_in_memory = 0
+        self.transition_counter = 0
+        self.log: deque = deque(maxlen=100_000)
+        self.stimulus_log: deque = deque(maxlen=10_000)
+        self.rng = random.Random(0)  # deterministic (reference wsm.py:1328)
+        self.task_counter: defaultdict[str, int] = defaultdict(int)
+
+        self._transitions_table: dict[tuple[str, str], Callable] = {
+            ("released", "waiting"): self._transition_released_waiting,
+            ("released", "fetch"): self._transition_released_fetch,
+            ("released", "memory"): self._transition_released_memory,
+            ("released", "forgotten"): self._transition_released_forgotten,
+            ("waiting", "ready"): self._transition_waiting_ready,
+            ("waiting", "constrained"): self._transition_waiting_constrained,
+            ("waiting", "released"): self._transition_generic_released,
+            ("ready", "executing"): self._transition_ready_executing,
+            ("ready", "released"): self._transition_generic_released,
+            ("constrained", "executing"): self._transition_constrained_executing,
+            ("constrained", "released"): self._transition_generic_released,
+            ("executing", "memory"): self._transition_executing_memory,
+            ("executing", "error"): self._transition_executing_error,
+            ("executing", "released"): self._transition_executing_released,
+            ("executing", "rescheduled"): self._transition_executing_rescheduled,
+            ("executing", "long-running"): self._transition_executing_long_running,
+            ("long-running", "memory"): self._transition_executing_memory,
+            ("long-running", "error"): self._transition_executing_error,
+            ("long-running", "released"): self._transition_executing_released,
+            ("long-running", "rescheduled"): self._transition_executing_rescheduled,
+            ("fetch", "flight"): self._transition_fetch_flight,
+            ("fetch", "released"): self._transition_generic_released,
+            ("fetch", "missing"): self._transition_fetch_missing,
+            ("flight", "memory"): self._transition_flight_memory,
+            ("flight", "fetch"): self._transition_flight_fetch,
+            ("flight", "released"): self._transition_flight_released,
+            ("flight", "missing"): self._transition_flight_missing,
+            ("missing", "fetch"): self._transition_missing_fetch,
+            ("missing", "released"): self._transition_generic_released,
+            ("memory", "released"): self._transition_memory_released,
+            ("cancelled", "released"): self._transition_cancelled_released,
+            ("cancelled", "memory"): self._transition_cancelled_memory,
+            ("cancelled", "error"): self._transition_cancelled_error,
+            ("cancelled", "rescheduled"): self._transition_cancelled_released,
+            ("resumed", "memory"): self._transition_executing_memory,
+            ("resumed", "released"): self._transition_generic_released,
+            ("resumed", "error"): self._transition_executing_error,
+            ("error", "released"): self._transition_generic_released,
+            ("rescheduled", "released"): self._transition_generic_released,
+        }
+
+    # ------------------------------------------------------------- stimulus
+
+    def handle_stimulus(self, *events: StateMachineEvent) -> Instructions:
+        """Feed events, return the instructions the shell must execute
+        (reference wsm.py:1330)."""
+        instructions: Instructions = []
+        for event in events:
+            self.stimulus_log.append(event)
+            handler = getattr(self, "_handle_" + _snake(type(event).__name__))
+            recs, instr = handler(event)
+            instructions += instr
+            instructions += self._transitions(recs, stimulus_id=event.stimulus_id)
+            instructions += self._ensure_computing(event.stimulus_id)
+            instructions += self._ensure_communicating(event.stimulus_id)
+        if self.validate:
+            self.validate_state()
+        return instructions
+
+    # -------------------------------------------------------- event handlers
+
+    def _handle_compute_task(self, ev: ComputeTaskEvent) -> tuple[Recs, Instructions]:
+        ts = self.tasks.get(ev.key)
+        if ts is None:
+            ts = self.tasks[ev.key] = WTaskState(ev.key)
+        ts.run_spec = ev.run_spec
+        ts.priority = tuple(ev.priority)
+        ts.duration = ev.duration
+        ts.resource_restrictions = dict(ev.resource_restrictions)
+        ts.actor = ev.actor
+        ts.annotations = dict(ev.annotations)
+        ts.span_id = ev.span_id
+        ts.stimulus_id = ev.stimulus_id
+
+        recs: Recs = {}
+        if ts.state in ("memory", "error", "executing", "long-running", "waiting",
+                        "ready", "constrained"):
+            # duplicate compute-task: already underway or done
+            if ts.state == "memory":
+                return recs, [
+                    TaskFinishedMsg(
+                        stimulus_id=ev.stimulus_id,
+                        key=ts.key,
+                        nbytes=ts.nbytes,
+                        typename=None,
+                        startstops=(),
+                    )
+                ]
+            return recs, []
+        if ts.state == "cancelled":
+            # scheduler wants it again: resume towards executing
+            ts.state = "resumed"
+            ts.next = "executing"
+            return recs, []
+
+        # wire up dependencies
+        for dep_key, workers in ev.who_has.items():
+            dts = self.tasks.get(dep_key)
+            if dts is None:
+                dts = self.tasks[dep_key] = WTaskState(dep_key)
+                dts.priority = ts.priority
+            dts.who_has = set(workers)
+            dts.nbytes = ev.nbytes.get(dep_key, dts.nbytes)
+            ts.dependencies.add(dts)
+            dts.dependents.add(ts)
+            if dts.state not in ("memory", "flight", "executing", "long-running"):
+                if dep_key in self.data:
+                    recs[dts] = "memory"
+                else:
+                    ts.waiting_for_data.add(dts)
+                    dts.waiters.add(ts)
+                    if dts.state not in FETCH_STATES and dts.state != "missing":
+                        recs[dts] = "fetch"
+            elif dts.state == "flight":
+                ts.waiting_for_data.add(dts)
+                dts.waiters.add(ts)
+        recs[ts] = "waiting"
+        return recs, []
+
+    def _handle_execute_success(self, ev: ExecuteSuccessEvent) -> tuple[Recs, Instructions]:
+        ts = self.tasks.get(ev.key)
+        if ts is None:
+            return {}, []
+        ts.done = True
+        if ts.state == "cancelled":
+            return {ts: "released"}, []
+        ts.nbytes = ev.nbytes
+        self.data[ts.key] = ev.value
+        return {ts: ("memory", ev)}, []
+
+    def _handle_execute_failure(self, ev: ExecuteFailureEvent) -> tuple[Recs, Instructions]:
+        ts = self.tasks.get(ev.key)
+        if ts is None:
+            return {}, []
+        ts.done = True
+        if ts.state == "cancelled":
+            return {ts: "released"}, []
+        return {ts: ("error", ev)}, []
+
+    def _handle_reschedule(self, ev: RescheduleEvent) -> tuple[Recs, Instructions]:
+        ts = self.tasks.get(ev.key)
+        if ts is None:
+            return {}, []
+        ts.done = True
+        return {ts: "rescheduled"}, []
+
+    def _handle_long_running(self, ev: LongRunningEvent) -> tuple[Recs, Instructions]:
+        ts = self.tasks.get(ev.key)
+        if ts is None or ts.state not in ("executing",):
+            return {}, []
+        return {ts: ("long-running", ev)}, []
+
+    def _handle_gather_dep_success(self, ev: GatherDepSuccessEvent) -> tuple[Recs, Instructions]:
+        recs: Recs = {}
+        instr: Instructions = []
+        self._gather_finished(ev.worker)
+        received = set(ev.data)
+        for key, value in ev.data.items():
+            ts = self.tasks.get(key)
+            if ts is None or ts.state != "flight":
+                # unsolicited data: keep it if someone may want it, else drop
+                if ts is not None and ts.state == "cancelled":
+                    recs[ts] = "released"
+                continue
+            self.data[key] = value
+            recs[ts] = "memory"
+        if received:
+            instr.append(AddKeysMsg(stimulus_id=ev.stimulus_id, keys=tuple(received)))
+        # keys requested but not received: the peer no longer has them
+        requested = self.in_flight_workers.pop(ev.worker, set())
+        for key in requested - received:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            self.in_flight_tasks.discard(ts)
+            ts.coming_from = None
+            ts.who_has.discard(ev.worker)
+            self.has_what[ev.worker].discard(key)
+            if ts.state == "flight":
+                recs[ts] = "fetch" if ts.who_has else "missing"
+            elif ts.state in ("cancelled", "resumed"):
+                recs[ts] = "released"
+        return recs, instr
+
+    def _handle_gather_dep_busy(self, ev: GatherDepBusyEvent) -> tuple[Recs, Instructions]:
+        self._gather_finished(ev.worker)
+        self.busy_workers.add(ev.worker)
+        recs: Recs = {}
+        requested = self.in_flight_workers.pop(ev.worker, set())
+        for key in requested:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            self.in_flight_tasks.discard(ts)
+            ts.coming_from = None
+            if ts.state == "flight":
+                recs[ts] = "fetch"
+            elif ts.state in ("cancelled", "resumed"):
+                recs[ts] = "released"
+        return recs, [
+            RetryBusyWorkerLater(stimulus_id=ev.stimulus_id, worker=ev.worker)
+        ]
+
+    def _handle_gather_dep_network_failure(
+        self, ev: GatherDepNetworkFailureEvent
+    ) -> tuple[Recs, Instructions]:
+        self._gather_finished(ev.worker)
+        recs: Recs = {}
+        instr: Instructions = []
+        requested = self.in_flight_workers.pop(ev.worker, set())
+        for key in requested:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            self.in_flight_tasks.discard(ts)
+            ts.coming_from = None
+            ts.who_has.discard(ev.worker)
+            self.has_what[ev.worker].discard(key)
+            instr.append(
+                MissingDataMsg(
+                    stimulus_id=ev.stimulus_id, key=key, errant_worker=ev.worker
+                )
+            )
+            if ts.state == "flight":
+                recs[ts] = "fetch" if ts.who_has else "missing"
+            elif ts.state in ("cancelled", "resumed"):
+                recs[ts] = "released"
+        return recs, instr
+
+    def _handle_gather_dep_failure(self, ev: GatherDepFailureEvent) -> tuple[Recs, Instructions]:
+        self._gather_finished(ev.worker)
+        recs: Recs = {}
+        requested = self.in_flight_workers.pop(ev.worker, set())
+        for key in requested:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            self.in_flight_tasks.discard(ts)
+            ts.coming_from = None
+            ts.exception = ev.exception
+            ts.traceback = ev.traceback
+            if ts.state == "flight":
+                recs[ts] = ("error", ev)
+            else:
+                recs[ts] = "released"
+        return recs, []
+
+    def _handle_free_keys(self, ev: FreeKeysEvent) -> tuple[Recs, Instructions]:
+        """Scheduler says these keys are no longer needed (cancellation)."""
+        recs: Recs = {}
+        for key in ev.keys:
+            ts = self.tasks.get(key)
+            if ts is not None:
+                recs[ts] = "released"
+        return recs, []
+
+    def _handle_remove_replicas(self, ev: RemoveReplicasEvent) -> tuple[Recs, Instructions]:
+        """AMM drops replicas; only memory tasks without local waiters go."""
+        recs: Recs = {}
+        instr: Instructions = []
+        for key in ev.keys:
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            if ts.state == "memory" and not any(
+                d.state in PROCESSING_STATES for d in ts.dependents
+            ):
+                recs[ts] = "released"
+                instr.append(ReleaseWorkerDataMsg(stimulus_id=ev.stimulus_id, key=key))
+            elif ts.state == "memory":
+                instr.append(AddKeysMsg(stimulus_id=ev.stimulus_id, keys=(key,)))
+        return recs, instr
+
+    def _handle_acquire_replicas(self, ev: AcquireReplicasEvent) -> tuple[Recs, Instructions]:
+        recs: Recs = {}
+        for key, workers in ev.who_has.items():
+            ts = self.tasks.get(key)
+            if ts is None:
+                ts = self.tasks[key] = WTaskState(key)
+                ts.priority = (1_000_000,)  # replicas fetch at low priority
+            ts.who_has = set(workers)
+            ts.nbytes = ev.nbytes.get(key, ts.nbytes)
+            if ts.state in ("released", "missing") and key not in self.data:
+                recs[ts] = "fetch"
+        return recs, []
+
+    def _handle_steal_request(self, ev: StealRequestEvent) -> tuple[Recs, Instructions]:
+        """Reference stealing.py:44-60: give up the task iff it has not
+        started running."""
+        ts = self.tasks.get(ev.key)
+        state = ts.state if ts is not None else None
+        instr: Instructions = [
+            StealResponseMsg(stimulus_id=ev.stimulus_id, key=ev.key, state=state)
+        ]
+        recs: Recs = {}
+        if ts is not None and state in ("ready", "constrained", "waiting"):
+            recs[ts] = "released"
+        return recs, instr
+
+    def _handle_update_data(self, ev: UpdateDataEvent) -> tuple[Recs, Instructions]:
+        recs: Recs = {}
+        for key, value in ev.data.items():
+            ts = self.tasks.get(key)
+            if ts is None:
+                ts = self.tasks[key] = WTaskState(key)
+                ts.priority = (0,)
+            self.data[key] = value
+            recs[ts] = "memory"
+        return recs, [
+            AddKeysMsg(stimulus_id=ev.stimulus_id, keys=tuple(ev.data))
+        ]
+
+    def _handle_pause(self, ev: PauseEvent) -> tuple[Recs, Instructions]:
+        self.running = False
+        return {}, []
+
+    def _handle_unpause(self, ev: UnpauseEvent) -> tuple[Recs, Instructions]:
+        self.running = True
+        return {}, []
+
+    def _handle_retry_busy_worker(self, ev: RetryBusyWorkerEvent) -> tuple[Recs, Instructions]:
+        self.busy_workers.discard(ev.worker)
+        return {}, []
+
+    def _handle_find_missing(self, ev: FindMissingEvent) -> tuple[Recs, Instructions]:
+        missing = [
+            ts for ts in self.tasks.values() if ts.state == "missing"
+        ]
+        if not missing:
+            return {}, []
+        return {}, [
+            RequestRefreshWhoHasMsg(
+                stimulus_id=ev.stimulus_id, keys=tuple(ts.key for ts in missing)
+            )
+        ]
+
+    def _handle_refresh_who_has(self, ev: RefreshWhoHasEvent) -> tuple[Recs, Instructions]:
+        recs: Recs = {}
+        for key, workers in ev.who_has.items():
+            ts = self.tasks.get(key)
+            if ts is None:
+                continue
+            ts.who_has = set(workers)
+            for w in workers:
+                self.has_what[w].add(key)
+            if ts.state == "missing" and ts.who_has:
+                recs[ts] = "fetch"
+            elif ts.state == "fetch" and not ts.who_has:
+                recs[ts] = "missing"
+        return recs, []
+
+    # ------------------------------------------------------ transition engine
+
+    def _transitions(self, recs: Recs, stimulus_id: str) -> Instructions:
+        instructions: Instructions = []
+        remaining = dict(recs)
+        while remaining:
+            ts, finish = remaining.popitem()
+            instructions += self._transition(ts, finish, stimulus_id, remaining)
+        return instructions
+
+    def _transition(
+        self, ts: WTaskState, finish: Any, stimulus_id: str, remaining: dict
+    ) -> Instructions:
+        kwargs: dict = {}
+        if isinstance(finish, tuple):
+            finish, payload = finish
+            kwargs["payload"] = payload
+        start = ts.state
+        if start == finish:
+            return []
+        func = self._transitions_table.get((start, finish))
+        if func is None:
+            raise InvalidTransition(ts.key, start, str(finish), list(self.log))
+        self.transition_counter += 1
+        recs, instructions = func(ts, stimulus_id=stimulus_id, **kwargs)
+        self.log.append((ts.key, start, ts.state, stimulus_id))
+        remaining.update(recs)
+        return instructions
+
+    # ------------------------------------------------------------- handlers
+
+    def _transition_released_waiting(self, ts, *, stimulus_id):
+        ts.state = "waiting"
+        recs: Recs = {}
+        if not ts.waiting_for_data:
+            recs[ts] = "constrained" if ts.resource_restrictions else "ready"
+        return recs, []
+
+    def _transition_released_fetch(self, ts, *, stimulus_id):
+        if not ts.who_has:
+            return {ts: "missing"}, []
+        ts.state = "fetch"
+        for w in ts.who_has:
+            self.has_what[w].add(ts.key)
+            self.data_needed[w].add(ts)
+        return {}, []
+
+    def _transition_released_memory(self, ts, *, stimulus_id):
+        return self._put_memory(ts, stimulus_id, send_add_keys=True)
+
+    def _transition_released_forgotten(self, ts, *, stimulus_id):
+        if ts.dependents:
+            return {}, []
+        for dts in ts.dependencies:
+            dts.dependents.discard(ts)
+            dts.waiters.discard(ts)
+            if not dts.dependents and dts.state == "released":
+                pass  # will be forgotten by its own release path
+        ts.dependencies.clear()
+        self.tasks.pop(ts.key, None)
+        ts.state = "forgotten"
+        return {}, []
+
+    def _transition_waiting_ready(self, ts, *, stimulus_id):
+        if self.validate:
+            assert not ts.waiting_for_data, ts
+            assert all(d.key in self.data for d in ts.dependencies), ts
+        ts.state = "ready"
+        self.ready.add(ts)
+        return {}, []
+
+    def _transition_waiting_constrained(self, ts, *, stimulus_id):
+        ts.state = "constrained"
+        self.constrained.append(ts)
+        return {}, []
+
+    def _transition_ready_executing(self, ts, *, stimulus_id):
+        self.ready.discard(ts)
+        return self._start_executing(ts, stimulus_id)
+
+    def _transition_constrained_executing(self, ts, *, stimulus_id):
+        try:
+            self.constrained.remove(ts)
+        except ValueError:
+            pass
+        for r, q in ts.resource_restrictions.items():
+            self.available_resources[r] -= q
+        return self._start_executing(ts, stimulus_id)
+
+    def _start_executing(self, ts, stimulus_id):
+        ts.state = "executing"
+        self.executing.add(ts)
+        return {}, [Execute(stimulus_id=stimulus_id, key=ts.key)]
+
+    def _transition_executing_memory(self, ts, *, stimulus_id, payload=None):
+        self._exit_executing(ts)
+        recs, instr = self._put_memory(ts, stimulus_id, send_add_keys=False)
+        ev = payload
+        startstops = ()
+        if isinstance(ev, ExecuteSuccessEvent):
+            startstops = (
+                {"action": "compute", "start": ev.start, "stop": ev.stop},
+            )
+            ts.nbytes = ev.nbytes
+        instr.append(
+            TaskFinishedMsg(
+                stimulus_id=stimulus_id,
+                key=ts.key,
+                nbytes=ts.nbytes,
+                typename=getattr(ev, "type", None),
+                startstops=startstops,
+            )
+        )
+        return recs, instr
+
+    def _transition_executing_error(self, ts, *, stimulus_id, payload=None):
+        self._exit_executing(ts)
+        ev = payload
+        if ev is not None:
+            ts.exception = getattr(ev, "exception", None)
+            ts.traceback = getattr(ev, "traceback", None)
+            ts.exception_text = getattr(ev, "exception_text", "")
+            ts.traceback_text = getattr(ev, "traceback_text", "")
+        ts.state = "error"
+        return {}, [
+            TaskErredMsg(
+                stimulus_id=stimulus_id,
+                key=ts.key,
+                exception=ts.exception,
+                traceback=ts.traceback,
+                exception_text=ts.exception_text,
+                traceback_text=ts.traceback_text,
+            )
+        ]
+
+    def _transition_executing_released(self, ts, *, stimulus_id):
+        """Cancellation while running: we cannot interrupt the thread, so the
+        task enters `cancelled` until the executor reports back
+        (reference wsm.py cancelled/resumed semantics)."""
+        if ts.done:
+            return self._transition_generic_released(ts, stimulus_id=stimulus_id)
+        ts.previous = ts.state
+        ts.state = "cancelled"
+        ts.next = None
+        return {}, []
+
+    def _transition_executing_rescheduled(self, ts, *, stimulus_id):
+        self._exit_executing(ts)
+        ts.state = "rescheduled"
+        recs = {ts: "released"}
+        return recs, [RescheduleMsg(stimulus_id=stimulus_id, key=ts.key)]
+
+    def _transition_executing_long_running(self, ts, *, stimulus_id, payload=None):
+        self.executing.discard(ts)
+        self.long_running.add(ts)
+        ts.state = "long-running"
+        dur = getattr(payload, "compute_duration", 0.0) if payload else 0.0
+        return {}, [
+            LongRunningMsg(
+                stimulus_id=stimulus_id, key=ts.key, compute_duration=dur
+            )
+        ]
+
+    def _transition_fetch_flight(self, ts, *, stimulus_id):
+        ts.state = "flight"
+        self.in_flight_tasks.add(ts)
+        return {}, []
+
+    def _transition_fetch_missing(self, ts, *, stimulus_id):
+        self._purge_data_needed(ts)
+        ts.state = "missing"
+        return {}, []
+
+    def _transition_missing_fetch(self, ts, *, stimulus_id):
+        return self._transition_released_fetch(ts, stimulus_id=stimulus_id)
+
+    def _transition_flight_memory(self, ts, *, stimulus_id):
+        self.in_flight_tasks.discard(ts)
+        ts.coming_from = None
+        return self._put_memory(ts, stimulus_id, send_add_keys=False)
+
+    def _transition_flight_fetch(self, ts, *, stimulus_id):
+        self.in_flight_tasks.discard(ts)
+        ts.coming_from = None
+        if not ts.who_has:
+            return {ts: "missing"}, []
+        ts.state = "fetch"
+        for w in ts.who_has:
+            self.data_needed[w].add(ts)
+        return {}, []
+
+    def _transition_flight_missing(self, ts, *, stimulus_id):
+        self.in_flight_tasks.discard(ts)
+        ts.coming_from = None
+        ts.state = "missing"
+        return {}, []
+
+    def _transition_flight_released(self, ts, *, stimulus_id):
+        # data may still arrive; remember to drop it
+        ts.previous = "flight"
+        ts.state = "cancelled"
+        return {}, []
+
+    def _transition_memory_released(self, ts, *, stimulus_id):
+        if ts.key in self.data:
+            self.nbytes_in_memory -= ts.nbytes
+            del self.data[ts.key]
+        self.actors.pop(ts.key, None)
+        return self._transition_generic_released(ts, stimulus_id=stimulus_id)
+
+    def _transition_cancelled_released(self, ts, *, stimulus_id):
+        if not ts.done and ts.previous in ("executing", "long-running"):
+            return {}, []  # still running; stay cancelled until done
+        ts.previous = None
+        return self._transition_generic_released(ts, stimulus_id=stimulus_id)
+
+    def _transition_cancelled_memory(self, ts, *, stimulus_id, payload=None):
+        # task was cancelled but completed anyway and scheduler re-wants it
+        return self._transition_executing_memory(
+            ts, stimulus_id=stimulus_id, payload=payload
+        )
+
+    def _transition_cancelled_error(self, ts, *, stimulus_id, payload=None):
+        return self._transition_executing_error(
+            ts, stimulus_id=stimulus_id, payload=payload
+        )
+
+    def _transition_generic_released(self, ts, *, stimulus_id):
+        """Pull the task out of every queue and release (or forget)."""
+        self._exit_executing(ts)
+        self.ready.discard(ts)
+        try:
+            self.constrained.remove(ts)
+        except ValueError:
+            pass
+        self.in_flight_tasks.discard(ts)
+        self._purge_data_needed(ts)
+        if ts.key in self.data:
+            self.nbytes_in_memory -= ts.nbytes
+            del self.data[ts.key]
+        self.actors.pop(ts.key, None)
+
+        recs: Recs = {}
+        for dts in ts.waiting_for_data:
+            dts.waiters.discard(ts)
+            if not dts.waiters and dts.state in (
+                "fetch", "flight", "missing",
+            ):
+                recs[dts] = "released"
+        ts.waiting_for_data.clear()
+        for dts in ts.dependencies:
+            dts.waiters.discard(ts)
+            if not dts.waiters and not dts.dependents - {ts} and dts.state == "released":
+                recs[dts] = "forgotten"
+        ts.state = "released"
+        if not ts.dependents:
+            recs[ts] = "forgotten"
+        return recs, []
+
+    # ---------------------------------------------------------- helper bits
+
+    def _put_memory(self, ts, stimulus_id, *, send_add_keys: bool):
+        if ts.key not in self.data:
+            # value was produced but already dropped: nothing to do
+            ts.state = "released"
+            return {}, []
+        self.nbytes_in_memory += ts.nbytes
+        ts.state = "memory"
+        self._purge_data_needed(ts)
+        recs: Recs = {}
+        for dts in list(ts.waiters):
+            dts.waiting_for_data.discard(ts)
+            if not dts.waiting_for_data and dts.state == "waiting":
+                recs[dts] = "constrained" if dts.resource_restrictions else "ready"
+        ts.waiters.clear()
+        instr: Instructions = []
+        if send_add_keys:
+            instr.append(AddKeysMsg(stimulus_id=stimulus_id, keys=(ts.key,)))
+        return recs, instr
+
+    def _exit_executing(self, ts) -> None:
+        self.executing.discard(ts)
+        self.long_running.discard(ts)
+        if ts.resource_restrictions and ts.state in ("executing", "long-running", "cancelled"):
+            for r, q in ts.resource_restrictions.items():
+                self.available_resources[r] += q
+
+    def _purge_data_needed(self, ts) -> None:
+        for w in ts.who_has:
+            dn = self.data_needed.get(w)
+            if dn is not None:
+                dn.discard(ts)
+                if not dn:
+                    del self.data_needed[w]
+
+    def _gather_finished(self, worker: str) -> None:
+        self.transfer_incoming_count = max(0, self.transfer_incoming_count - 1)
+
+    # ------------------------------------------------- scheduling decisions
+
+    def _ensure_computing(self, stimulus_id: str) -> Instructions:
+        """Fill execution slots from the ready/constrained queues
+        (reference wsm.py:1726)."""
+        if not self.running:
+            return []
+        instructions: Instructions = []
+        while self.constrained and self._executing_count() < self.nthreads:
+            ts = self.constrained[0]
+            if ts.state != "constrained":
+                self.constrained.popleft()
+                continue
+            if not all(
+                self.available_resources.get(r, 0) >= q
+                for r, q in ts.resource_restrictions.items()
+            ):
+                break
+            self.constrained.popleft()
+            instructions += self._transitions({ts: "executing"}, stimulus_id)
+        while self.ready and self._executing_count() < self.nthreads:
+            ts = self.ready.pop()
+            if ts.state != "ready":
+                continue
+            instructions += self._transitions({ts: "executing"}, stimulus_id)
+        return instructions
+
+    def _executing_count(self) -> int:
+        return len(self.executing)
+
+    def _ensure_communicating(self, stimulus_id: str) -> Instructions:
+        """Issue GatherDep instructions for fetchable tasks
+        (reference wsm.py:1531)."""
+        if not self.running:
+            return []
+        instructions: Instructions = []
+        while (
+            self.data_needed
+            and self.transfer_incoming_count < self.transfer_incoming_count_limit
+        ):
+            worker = self._select_worker_for_gather()
+            if worker is None:
+                break
+            to_gather, total_nbytes = self._select_keys_for_gather(worker)
+            if not to_gather:
+                break
+            self.in_flight_workers[worker] = set(to_gather)
+            self.transfer_incoming_count += 1
+            recs: Recs = {}
+            for key in to_gather:
+                ts = self.tasks[key]
+                ts.coming_from = worker
+                recs[ts] = "flight"
+            instructions += self._transitions(recs, stimulus_id)
+            instructions.append(
+                GatherDep(
+                    stimulus_id=stimulus_id,
+                    worker=worker,
+                    to_gather=tuple(to_gather),
+                    total_nbytes=total_nbytes,
+                )
+            )
+        return instructions
+
+    def _select_worker_for_gather(self) -> str | None:
+        """Pick the peer whose queue holds the highest-priority fetchable
+        task, skipping busy and already-in-flight peers (reference
+        wsm.py:1600)."""
+        best = None
+        best_pri = None
+        for worker, heap in list(self.data_needed.items()):
+            if worker in self.busy_workers or worker in self.in_flight_workers:
+                continue
+            while heap and heap.peek().state != "fetch":
+                heap.discard(heap.peek())
+            if not heap:
+                del self.data_needed[worker]
+                continue
+            pri = heap.peek().priority
+            if best_pri is None or pri < best_pri:
+                best_pri = pri
+                best = worker
+        return best
+
+    def _select_keys_for_gather(self, worker: str) -> tuple[list[Key], int]:
+        """Batch keys from one peer up to the message byte limit
+        (reference wsm.py:1664)."""
+        heap = self.data_needed.get(worker)
+        keys: list[Key] = []
+        total = 0
+        while heap:
+            ts = heap.peek()
+            if ts.state != "fetch":
+                heap.discard(ts)
+                continue
+            if keys and total + ts.nbytes > self.transfer_message_bytes_limit:
+                break
+            heap.discard(ts)
+            keys.append(ts.key)
+            total += ts.nbytes
+        if heap is not None and not heap:
+            self.data_needed.pop(worker, None)
+        return keys, total
+
+    # ------------------------------------------------------------ validation
+
+    def validate_state(self) -> None:
+        try:
+            for key, ts in self.tasks.items():
+                assert ts.key == key
+                if ts.state == "memory":
+                    assert key in self.data or ts.actor, ts
+                if ts.state == "executing":
+                    assert ts in self.executing, ts
+                if ts.state == "ready":
+                    assert ts in self.ready, ts
+                if ts.state == "flight":
+                    assert ts in self.in_flight_tasks, ts
+                for dts in ts.waiting_for_data:
+                    assert ts in dts.waiters, (ts, dts)
+                    assert dts.state != "memory", (ts, dts)
+            for ts in self.executing:
+                assert ts.state in ("executing", "cancelled"), ts
+            for worker, keys in self.in_flight_workers.items():
+                for key in keys:
+                    ts = self.tasks.get(key)
+                    assert ts is None or ts.state in ("flight", "cancelled", "resumed"), ts
+        except AssertionError as e:
+            raise InvalidTaskState(str(e)) from e
+
+    def story(self, *keys: Key) -> list[tuple]:
+        return [entry for entry in self.log if entry[0] in keys]
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i:
+            out.append("_")
+        out.append(c.lower())
+    s = "".join(out)
+    return s[: -len("_event")] if s.endswith("_event") else s

@@ -93,7 +93,8 @@ def oracle_sequential(raw, bandwidth=BW):
             key=lambda w: (cost[w], raw["wnbytes"][w], w),
         )
         out[t] = best
-        occ[best] += (np.float32(raw["durations"][t]) + missing[best] * inv_bw) / thr[best]
+        # raw seconds booked; divide once at compare (reference :3140)
+        occ[best] += np.float32(raw["durations"][t]) + missing[best] * inv_bw
     return out, occ
 
 
@@ -167,7 +168,8 @@ def test_occupancy_after_finish():
     fw = jnp.asarray(np.array([0, 0, 1, -1], np.int32))
     fd = jnp.asarray(np.array([2.0, 2.0, 1.0, 99.0], np.float32))
     out = np.asarray(occupancy_after_finish(occ, threads, fw, fd))
-    np.testing.assert_allclose(out, [3.0, 2.0, 1.0])
+    # raw-seconds booking: worker 0 releases 4.0, worker 1 releases 1.0
+    np.testing.assert_allclose(out, [1.0, 2.0, 1.0])
 
 
 # ---------------------------------------------------------- wavefront

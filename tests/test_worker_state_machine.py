@@ -1,0 +1,312 @@
+"""Tier-1 deterministic tests of the worker state machine (reference
+test_worker_state_machine.py style: drive a bare WorkerState with synthetic
+events, assert on returned Instructions and the transition log)."""
+
+from __future__ import annotations
+
+import pytest
+
+from distributed_tpu.worker.state_machine import (
+    AddKeysMsg,
+    ComputeTaskEvent,
+    Execute,
+    ExecuteFailureEvent,
+    ExecuteSuccessEvent,
+    FreeKeysEvent,
+    GatherDep,
+    GatherDepBusyEvent,
+    GatherDepNetworkFailureEvent,
+    GatherDepSuccessEvent,
+    LongRunningEvent,
+    LongRunningMsg,
+    MissingDataMsg,
+    PauseEvent,
+    RefreshWhoHasEvent,
+    RequestRefreshWhoHasMsg,
+    RetryBusyWorkerEvent,
+    RetryBusyWorkerLater,
+    StealRequestEvent,
+    StealResponseMsg,
+    TaskErredMsg,
+    TaskFinishedMsg,
+    UnpauseEvent,
+    UpdateDataEvent,
+    WorkerState,
+    FindMissingEvent,
+)
+
+
+@pytest.fixture
+def ws():
+    state = WorkerState(nthreads=2, address="tcp://self:1", validate=True)
+    yield state
+    state.validate_state()
+
+
+def finish_exec(ws, key, value=42, nbytes=8):
+    return ws.handle_stimulus(
+        ExecuteSuccessEvent(
+            stimulus_id="s-done", key=key, value=value, start=1.0, stop=2.0,
+            nbytes=nbytes, type="int",
+        )
+    )
+
+
+def test_simple_execution(ws):
+    instrs = ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+    assert [type(i) for i in instrs] == [Execute]
+    assert ws.tasks["x"].state == "executing"
+    instrs = finish_exec(ws, "x")
+    assert [type(i) for i in instrs] == [TaskFinishedMsg]
+    assert ws.tasks["x"].state == "memory"
+    assert ws.data["x"] == 42
+
+
+def test_execution_failure(ws):
+    ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+    instrs = ws.handle_stimulus(
+        ExecuteFailureEvent(
+            stimulus_id="s-err", key="x", exception=ValueError("boom"),
+            exception_text="boom",
+        )
+    )
+    assert [type(i) for i in instrs] == [TaskErredMsg]
+    assert ws.tasks["x"].state == "error"
+
+
+def test_thread_slots_respected(ws):
+    for i in range(5):
+        ws.handle_stimulus(ComputeTaskEvent.dummy(f"t{i}", priority=(i,)))
+    states = [ws.tasks[f"t{i}"].state for i in range(5)]
+    assert states.count("executing") == 2  # nthreads=2
+    assert states.count("ready") == 3
+    # finishing one starts the next by priority
+    finish_exec(ws, "t0")
+    assert ws.tasks["t2"].state == "executing"
+
+
+def test_priority_order(ws):
+    ws.handle_stimulus(PauseEvent(stimulus_id="p"))
+    for key, pri in [("low", (9,)), ("high", (1,)), ("mid", (5,))]:
+        ws.handle_stimulus(ComputeTaskEvent.dummy(key, priority=pri))
+    instrs = ws.handle_stimulus(UnpauseEvent(stimulus_id="u"))
+    keys = [i.key for i in instrs if isinstance(i, Execute)]
+    assert keys == ["high", "mid"]  # two slots, best priorities first
+
+
+def test_dependency_fetch_flow(ws):
+    """compute-task with a remote dep: fetch -> flight -> memory -> execute."""
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "y",
+            priority=(0,),
+            who_has={"dep": ["tcp://peer:1"]},
+            nbytes={"dep": 100},
+        )
+    )
+    gd = [i for i in instrs if isinstance(i, GatherDep)]
+    assert len(gd) == 1
+    assert gd[0].worker == "tcp://peer:1"
+    assert gd[0].to_gather == ("dep",)
+    assert ws.tasks["dep"].state == "flight"
+    assert ws.tasks["y"].state == "waiting"
+
+    instrs = ws.handle_stimulus(
+        GatherDepSuccessEvent(
+            stimulus_id="s-gd", worker="tcp://peer:1", data={"dep": 7},
+            total_nbytes=100,
+        )
+    )
+    assert ws.tasks["dep"].state == "memory"
+    assert any(isinstance(i, AddKeysMsg) for i in instrs)
+    assert any(isinstance(i, Execute) and i.key == "y" for i in instrs)
+    finish_exec(ws, "y")
+    assert ws.tasks["y"].state == "memory"
+
+
+def test_gather_batching_respects_byte_limit():
+    ws = WorkerState(nthreads=1, validate=True, transfer_message_bytes_limit=150)
+    who_has = {f"d{i}": ["tcp://peer:1"] for i in range(4)}
+    nbytes = {f"d{i}": 100 for i in range(4)}
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("y", priority=(0,), who_has=who_has, nbytes=nbytes)
+    )
+    gds = [i for i in instrs if isinstance(i, GatherDep)]
+    # 100+100 > 150 -> one key per message, but only 1 concurrent per peer
+    assert len(gds) == 1
+    assert len(gds[0].to_gather) == 1
+
+
+def test_gather_spreads_across_peers(ws):
+    who_has = {"d1": ["tcp://p1:1"], "d2": ["tcp://p2:1"]}
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("y", priority=(0,), who_has=who_has,
+                               nbytes={"d1": 10, "d2": 10})
+    )
+    gds = [i for i in instrs if isinstance(i, GatherDep)]
+    assert {g.worker for g in gds} == {"tcp://p1:1", "tcp://p2:1"}
+
+
+def test_busy_peer_retry(ws):
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy("y", priority=(0,),
+                               who_has={"dep": ["tcp://peer:1"]},
+                               nbytes={"dep": 10})
+    )
+    instrs = ws.handle_stimulus(
+        GatherDepBusyEvent(stimulus_id="s-busy", worker="tcp://peer:1",
+                           keys=("dep",))
+    )
+    assert any(isinstance(i, RetryBusyWorkerLater) for i in instrs)
+    assert ws.tasks["dep"].state == "fetch"  # requeued
+    assert "tcp://peer:1" in ws.busy_workers
+    # retry clears busy and re-issues the gather
+    instrs = ws.handle_stimulus(
+        RetryBusyWorkerEvent(stimulus_id="s-retry", worker="tcp://peer:1")
+    )
+    assert any(isinstance(i, GatherDep) for i in instrs)
+
+
+def test_network_failure_reroutes(ws):
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "y", priority=(0,),
+            who_has={"dep": ["tcp://p1:1", "tcp://p2:1"]},
+            nbytes={"dep": 10},
+        )
+    )
+    flight_worker = ws.tasks["dep"].coming_from
+    other = ({"tcp://p1:1", "tcp://p2:1"} - {flight_worker}).pop()
+    instrs = ws.handle_stimulus(
+        GatherDepNetworkFailureEvent(
+            stimulus_id="s-net", worker=flight_worker, keys=("dep",)
+        )
+    )
+    assert any(isinstance(i, MissingDataMsg) for i in instrs)
+    # rerouted to the surviving peer
+    gds = [i for i in instrs if isinstance(i, GatherDep)]
+    assert gds and gds[0].worker == other
+
+
+def test_missing_then_refresh(ws):
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy("y", priority=(0,),
+                               who_has={"dep": ["tcp://p1:1"]},
+                               nbytes={"dep": 10})
+    )
+    ws.handle_stimulus(
+        GatherDepNetworkFailureEvent(stimulus_id="s", worker="tcp://p1:1",
+                                     keys=("dep",))
+    )
+    assert ws.tasks["dep"].state == "missing"
+    instrs = ws.handle_stimulus(FindMissingEvent(stimulus_id="fm"))
+    assert any(isinstance(i, RequestRefreshWhoHasMsg) for i in instrs)
+    instrs = ws.handle_stimulus(
+        RefreshWhoHasEvent(stimulus_id="r", who_has={"dep": ["tcp://p3:1"]})
+    )
+    gds = [i for i in instrs if isinstance(i, GatherDep)]
+    assert gds and gds[0].worker == "tcp://p3:1"
+
+
+def test_steal_request_ready_task():
+    ws = WorkerState(nthreads=1, validate=True)
+    ws.handle_stimulus(ComputeTaskEvent.dummy("a", priority=(0,)))
+    ws.handle_stimulus(ComputeTaskEvent.dummy("b", priority=(1,)))
+    assert ws.tasks["b"].state == "ready"
+    instrs = ws.handle_stimulus(StealRequestEvent(stimulus_id="st", key="b"))
+    resp = [i for i in instrs if isinstance(i, StealResponseMsg)]
+    assert resp[0].state == "ready"
+    assert "b" not in ws.tasks  # released + forgotten
+
+
+def test_steal_request_executing_task_is_refused(ws):
+    ws.handle_stimulus(ComputeTaskEvent.dummy("a", priority=(0,)))
+    instrs = ws.handle_stimulus(StealRequestEvent(stimulus_id="st", key="a"))
+    resp = [i for i in instrs if isinstance(i, StealResponseMsg)]
+    assert resp[0].state == "executing"
+    assert ws.tasks["a"].state == "executing"  # not given up
+
+
+def test_cancel_executing_goes_cancelled(ws):
+    ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="free", keys=("x",)))
+    assert ws.tasks["x"].state == "cancelled"
+    # completion of a cancelled task drops the result silently
+    instrs = finish_exec(ws, "x")
+    assert not any(isinstance(i, TaskFinishedMsg) for i in instrs)
+    assert "x" not in ws.tasks
+    assert "x" not in ws.data
+
+
+def test_cancel_ready_released_immediately():
+    ws = WorkerState(nthreads=1, validate=True)
+    ws.handle_stimulus(ComputeTaskEvent.dummy("a", priority=(0,)))
+    ws.handle_stimulus(ComputeTaskEvent.dummy("b", priority=(1,)))
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="free", keys=("b",)))
+    assert "b" not in ws.tasks
+
+
+def test_pause_stops_execution_and_gathers(ws):
+    ws.handle_stimulus(PauseEvent(stimulus_id="p"))
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("x", priority=(0,),
+                               who_has={"d": ["tcp://p:1"]}, nbytes={"d": 1})
+    )
+    assert not any(isinstance(i, (Execute, GatherDep)) for i in instrs)
+    instrs = ws.handle_stimulus(UnpauseEvent(stimulus_id="u"))
+    assert any(isinstance(i, GatherDep) for i in instrs)
+
+
+def test_long_running_frees_slot():
+    ws = WorkerState(nthreads=1, validate=True)
+    ws.handle_stimulus(ComputeTaskEvent.dummy("a", priority=(0,)))
+    ws.handle_stimulus(ComputeTaskEvent.dummy("b", priority=(1,)))
+    assert ws.tasks["b"].state == "ready"
+    instrs = ws.handle_stimulus(
+        LongRunningEvent(stimulus_id="lr", key="a", compute_duration=1.0)
+    )
+    assert any(isinstance(i, LongRunningMsg) for i in instrs)
+    assert ws.tasks["a"].state == "long-running"
+    assert ws.tasks["b"].state == "executing"  # slot freed
+    finish_exec(ws, "a")
+    assert ws.tasks["a"].state == "memory"
+
+
+def test_update_data(ws):
+    instrs = ws.handle_stimulus(
+        UpdateDataEvent(stimulus_id="ud", data={"k": 123})
+    )
+    assert any(isinstance(i, AddKeysMsg) for i in instrs)
+    assert ws.data["k"] == 123
+    assert ws.tasks["k"].state == "memory"
+
+
+def test_resources_constrain_execution():
+    ws = WorkerState(nthreads=4, validate=True, resources={"GPU": 1})
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy("g1", priority=(0,),
+                               resource_restrictions={"GPU": 1})
+    )
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy("g2", priority=(1,),
+                               resource_restrictions={"GPU": 1})
+    )
+    assert ws.tasks["g1"].state == "executing"
+    assert ws.tasks["g2"].state == "constrained"  # GPU exhausted
+    finish_exec(ws, "g1")
+    assert ws.tasks["g2"].state == "executing"
+    assert ws.available_resources["GPU"] == 0
+
+
+def test_story(ws):
+    ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+    finish_exec(ws, "x")
+    transitions = [(t[1], t[2]) for t in ws.story("x")]
+    assert ("released", "waiting") in transitions
+    assert ("ready", "executing") in transitions or ("waiting", "ready") in transitions
+    assert ("executing", "memory") in transitions
+
+
+def test_deterministic_stimulus_log(ws):
+    ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+    assert len(ws.stimulus_log) == 1
