@@ -1,0 +1,277 @@
+"""TCP / TLS comm backend over asyncio streams.
+
+Wire format per message (reference comm/tcp.py:372 shape):
+
+    uint64  n_frames
+    uint64  length[n_frames]
+    bytes   frame[0] ... frame[n_frames-1]
+
+Frames come from ``protocol.dumps`` (msgpack header + body + payload).
+Writes of large frames go straight to the transport without an extra copy;
+reads use ``readexactly``.  TLS wraps the same streams with an
+``ssl.SSLContext`` built by ``distributed_tpu.security.Security``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+from typing import Any, Callable
+
+from distributed_tpu import config
+from distributed_tpu.comm.addressing import parse_host_port, unparse_host_port
+from distributed_tpu.comm.core import Backend, Comm, Connector, Listener, register_backend
+from distributed_tpu.exceptions import CommClosedError, FatalCommClosedError
+from distributed_tpu.protocol import dumps, loads
+
+_u64 = struct.Struct("<Q")
+
+MAX_FRAME_COUNT = 2**20  # sanity bound on header
+
+
+def _set_tcp_options(sock: socket.socket) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+
+
+class TCP(Comm):
+    scheme = "tcp"
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 local_addr: str, peer_addr: str, deserialize: bool = True):
+        super().__init__(deserialize=deserialize)
+        self._reader = reader
+        self._writer = writer
+        self._local_addr = local_addr
+        self._peer_addr = peer_addr
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+
+    async def read(self) -> Any:
+        try:
+            head = await self._reader.readexactly(8)
+            (n_frames,) = _u64.unpack(head)
+            if n_frames > MAX_FRAME_COUNT:
+                raise CommClosedError(f"bad frame count {n_frames}")
+            lengths_raw = await self._reader.readexactly(8 * n_frames)
+            lengths = struct.unpack(f"<{n_frames}Q", lengths_raw)
+            frames = [await self._reader.readexactly(n) for n in lengths]
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError,
+                OSError) as e:
+            self.abort()
+            raise CommClosedError(f"read failed: {e!r}") from e
+        try:
+            return loads(frames, deserializers=self.deserialize)
+        except Exception:
+            self.abort()
+            raise
+
+    async def write(self, msg: Any, on_error: str = "message") -> int:
+        compression = self.handshake_options.get("compression", "auto")
+        try:
+            frames = dumps(msg, compression=compression)
+        except Exception:
+            if on_error == "raise":
+                raise
+            from distributed_tpu.utils import format_exception
+
+            frames = dumps({"op": "protocol-error", "error": format_exception()})
+        lengths = [memoryview(f).nbytes for f in frames]
+        header = _u64.pack(len(frames)) + struct.pack(f"<{len(frames)}Q", *lengths)
+        async with self._write_lock:
+            try:
+                self._writer.write(header)
+                for f in frames:
+                    self._writer.write(bytes(f) if isinstance(f, memoryview) else f)
+                await self._writer.drain()
+            except (ConnectionResetError, BrokenPipeError, RuntimeError, OSError) as e:
+                self.abort()
+                raise CommClosedError(f"write failed: {e!r}") from e
+        return sum(lengths) + len(header)
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._writer.can_write_eof():
+                self._writer.write_eof()
+            self._writer.close()
+            await asyncio.wait_for(self._writer.wait_closed(), 1.0)
+        except Exception:
+            pass
+
+    def abort(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._writer.transport.abort()
+            except Exception:
+                pass
+
+    @property
+    def local_address(self) -> str:
+        return self._local_addr
+
+    @property
+    def peer_address(self) -> str:
+        return self._peer_addr
+
+    @property
+    def closed(self) -> bool:
+        return self._closed or self._reader.at_eof()
+
+
+class TLS(TCP):
+    scheme = "tls"
+
+
+def _sock_addrs(writer: asyncio.StreamWriter, scheme: str) -> tuple[str, str]:
+    sock = writer.get_extra_info("sockname")
+    peer = writer.get_extra_info("peername")
+
+    def fmt(sa):
+        if sa is None:
+            return f"{scheme}://<closed>"
+        host, port = sa[0], sa[1]
+        return f"{scheme}://{unparse_host_port(host, port)}"
+
+    return fmt(sock), fmt(peer)
+
+
+class TCPConnector(Connector):
+    scheme = "tcp"
+    ssl_context = None
+
+    async def connect(self, address: str, deserialize: bool = True, **kwargs: Any) -> Comm:
+        host, port = parse_host_port(address)
+        ssl_ctx = kwargs.get("ssl_context", self.ssl_context)
+        try:
+            reader, writer = await asyncio.open_connection(
+                host, port, ssl=ssl_ctx, limit=2**24
+            )
+        except ConnectionRefusedError as e:
+            raise CommClosedError(f"connection refused: {address}") from e
+        except (ssl_error_types()) as e:
+            raise FatalCommClosedError(f"TLS failure connecting to {address}: {e!r}") from e
+        sock = writer.get_extra_info("socket")
+        if sock is not None and ssl_ctx is None:
+            _set_tcp_options(sock)
+        local, peer = _sock_addrs(writer, self.scheme)
+        cls = TLS if ssl_ctx is not None else TCP
+        return cls(reader, writer, local, f"{self.scheme}://{address}", deserialize)
+
+
+def ssl_error_types():
+    import ssl
+
+    return (ssl.SSLError, ssl.CertificateError)
+
+
+class TLSConnector(TCPConnector):
+    scheme = "tls"
+
+    async def connect(self, address: str, deserialize: bool = True, **kwargs: Any) -> Comm:
+        if kwargs.get("ssl_context") is None:
+            from distributed_tpu.security import Security
+
+            kwargs["ssl_context"] = Security().get_connection_args("client").get("ssl_context")
+        if kwargs["ssl_context"] is None:
+            raise FatalCommClosedError("tls:// requires an ssl_context (configure comm.tls)")
+        return await super().connect(address, deserialize, **kwargs)
+
+
+class TCPListener(Listener):
+    scheme = "tcp"
+
+    def __init__(self, loc: str, handle_comm: Callable, deserialize: bool = True,
+                 **kwargs: Any):
+        host, port = parse_host_port(loc or "0.0.0.0:0")
+        self.host = host or "0.0.0.0"
+        self.port = port
+        self.handle_comm = handle_comm
+        self.deserialize = deserialize
+        self.server: asyncio.AbstractServer | None = None
+        self.ssl_context = kwargs.get("ssl_context")
+        self._comms: set[Comm] = set()
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        sock = writer.get_extra_info("socket")
+        if sock is not None and self.ssl_context is None:
+            _set_tcp_options(sock)
+        local, peer = _sock_addrs(writer, self.scheme)
+        cls = TLS if self.ssl_context is not None else TCP
+        comm = cls(reader, writer, local, peer, self.deserialize)
+        try:
+            await self.on_connection(comm)
+        except CommClosedError:
+            return
+        self._comms.add(comm)
+        try:
+            await self.handle_comm(comm)
+        finally:
+            self._comms.discard(comm)
+
+    async def start(self) -> None:
+        backlog = config.get("comm.socket-backlog")
+        self.server = await asyncio.start_server(
+            self._on_connection, self.host, self.port or None,
+            ssl=self.ssl_context, backlog=backlog, limit=2**24, reuse_address=True,
+        )
+        if self.port == 0:
+            self.port = self.server.sockets[0].getsockname()[1]
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+
+    @property
+    def listen_address(self) -> str:
+        return f"{self.scheme}://{unparse_host_port(self.host, self.port)}"
+
+    @property
+    def contact_address(self) -> str:
+        host = self.host
+        if host in ("0.0.0.0", ""):
+            from distributed_tpu.utils import get_ip
+
+            host = get_ip()
+        return f"{self.scheme}://{unparse_host_port(host, self.port)}"
+
+
+class TLSListener(TCPListener):
+    scheme = "tls"
+
+    def __init__(self, loc: str, handle_comm: Callable, deserialize: bool = True,
+                 **kwargs: Any):
+        super().__init__(loc, handle_comm, deserialize, **kwargs)
+        if self.ssl_context is None:
+            from distributed_tpu.security import Security
+
+            self.ssl_context = Security().get_listen_args("scheduler").get("ssl_context")
+        if self.ssl_context is None:
+            raise ValueError("tls:// listener requires ssl_context (configure comm.tls)")
+
+
+class TCPBackend(Backend):
+    _connector_cls = TCPConnector
+    _listener_cls = TCPListener
+
+    def get_connector(self) -> Connector:
+        return self._connector_cls()
+
+    def get_listener(self, loc: str, handle_comm: Callable, deserialize: bool,
+                     **kwargs: Any) -> Listener:
+        return self._listener_cls(loc, handle_comm, deserialize, **kwargs)
+
+
+class TLSBackend(TCPBackend):
+    _connector_cls = TLSConnector
+    _listener_cls = TLSListener
+
+
+register_backend("tcp", TCPBackend())
+register_backend("tls", TLSBackend())

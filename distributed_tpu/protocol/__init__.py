@@ -1,0 +1,26 @@
+from distributed_tpu.protocol.core import dumps, loads
+from distributed_tpu.protocol.serialize import (
+    Pickled,
+    Serialize,
+    Serialized,
+    ToPickle,
+    deserialize,
+    nested_deserialize,
+    register_serialization_family,
+    serialize,
+    to_serialize,
+)
+
+__all__ = [
+    "dumps",
+    "loads",
+    "serialize",
+    "deserialize",
+    "nested_deserialize",
+    "register_serialization_family",
+    "Serialize",
+    "Serialized",
+    "ToPickle",
+    "Pickled",
+    "to_serialize",
+]

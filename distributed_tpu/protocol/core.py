@@ -1,0 +1,185 @@
+"""Frame protocol: message <-> list of frames.
+
+Wire format (reference protocol/core.py:26-140 semantics, simplified):
+
+    frames[0]  msgpack header: {"compression": [...], "lengths": [...],
+                                "serialized": {path: subheader}, "count": n}
+    frames[1]  msgpack body of the message with Serialize/ToPickle leaves
+               replaced by placeholder markers
+    frames[2:] out-of-band payload frames for each serialized leaf,
+               possibly compressed, big ones split at ``comm.shard``
+
+``dumps(msg)`` walks the message, extracts ``Serialize``/``Serialized``/
+``ToPickle`` leaves, serializes each through the family registry
+(protocol/serialize.py), compresses frames via sampling (maybe_compress),
+and msgpacks the skeleton.  ``loads(frames)`` reverses it.  Everything
+msgpack handles natively travels in the body; there is no pickle of the
+message envelope itself (messages from untrusted peers can be inspected
+before any unpickling happens).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+
+from distributed_tpu import config
+from distributed_tpu.protocol import pickle as _pickle
+from distributed_tpu.protocol.compression import (
+    decompress_frame,
+    get_default_compression,
+    maybe_compress,
+)
+from distributed_tpu.protocol.serialize import (
+    Pickled,
+    Serialize,
+    Serialized,
+    ToPickle,
+    deserialize,
+    serialize,
+)
+
+_PLACEHOLDER = "__dtpu_ser__"  # marker in the msgpack body
+_PICKLE_PLACEHOLDER = "__dtpu_pkl__"
+
+
+def _shard_size() -> int:
+    return config.parse_bytes(config.get("comm.shard"))
+
+
+def _extract(obj: Any, path: tuple, out: dict[tuple, Any]) -> Any:
+    """Replace serializable leaves with placeholders, collecting them."""
+    if isinstance(obj, (Serialize, Serialized)):
+        out[path] = obj
+        return {_PLACEHOLDER: list(path)}
+    if isinstance(obj, (ToPickle, Pickled)):
+        out[path] = obj
+        return {_PICKLE_PLACEHOLDER: list(path)}
+    if isinstance(obj, dict):
+        return {k: _extract(v, path + (k,), out) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_extract(v, path + (i,), out) for i, v in enumerate(obj)]
+    return obj
+
+
+def _msgpack_default(obj: Any):
+    # allow a few non-msgpack-native types in the envelope
+    if isinstance(obj, (set, frozenset)):
+        return {"__dtpu_set__": list(obj)}
+    if isinstance(obj, tuple):  # pragma: no cover - tuples already converted
+        return list(obj)
+    if isinstance(obj, bytearray):
+        return bytes(obj)
+    raise TypeError(f"cannot msgpack {type(obj)!r}")
+
+
+def _msgpack_hook(obj: dict):
+    if "__dtpu_set__" in obj and len(obj) == 1:
+        return set(obj["__dtpu_set__"])
+    return obj
+
+
+def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryview]:
+    """Serialize a message to a list of frames."""
+    if compression == "auto":
+        compression = get_default_compression() if config.get("comm.compression") else None
+
+    extracted: dict[tuple, Any] = {}
+    skeleton = _extract(msg, (), extracted)
+
+    sub_headers: list[dict] = []
+    payload_frames: list[Any] = []
+    frame_compression: list[str | None] = []
+    frame_lengths: list[int] = []
+    shard = _shard_size()
+
+    for path, leaf in extracted.items():
+        if isinstance(leaf, (Serialize, Serialized)):
+            head, frames = serialize(leaf)
+        elif isinstance(leaf, Pickled):
+            head, frames = leaf.header, leaf.frames
+        else:  # ToPickle
+            buffers: list = []
+            data = _pickle.dumps(leaf.data, buffer_callback=buffers.append)
+            head = {"serializer": "pickle", "num-buffers": len(buffers)}
+            frames = [data] + list(buffers)
+        # split big frames so no single read/write exceeds the shard size
+        split_frames: list = []
+        split_sizes: list[int] = []
+        for f in frames:
+            mv = memoryview(f).cast("B") if not isinstance(f, bytes) else f
+            n = memoryview(mv).nbytes
+            if n > shard:
+                parts = [mv[i : i + shard] for i in range(0, n, shard)]
+            else:
+                parts = [mv]
+            split_frames.extend(parts)
+            split_sizes.append(len(parts))
+        head["path"] = list(path)
+        head["frame-start"] = len(payload_frames)
+        head["splits"] = split_sizes
+        sub_headers.append(head)
+        for f in split_frames:
+            codec, data = maybe_compress(f, compression)
+            payload_frames.append(data)
+            frame_compression.append(codec)
+            frame_lengths.append(memoryview(data).nbytes)
+
+    header = {
+        "compression": frame_compression,
+        "lengths": frame_lengths,
+        "sub-headers": sub_headers,
+    }
+    body = msgpack.packb(skeleton, default=_msgpack_default, strict_types=False)
+    head_frame = msgpack.packb(header, default=_msgpack_default)
+    return [head_frame, body] + payload_frames
+
+
+def _plant(obj: Any, values: dict[tuple, Any]) -> Any:
+    if isinstance(obj, dict):
+        if _PLACEHOLDER in obj and len(obj) == 1:
+            return values[tuple(obj[_PLACEHOLDER])]
+        if _PICKLE_PLACEHOLDER in obj and len(obj) == 1:
+            return values[tuple(obj[_PICKLE_PLACEHOLDER])]
+        return {k: _plant(v, values) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_plant(v, values) for v in obj]
+    return obj
+
+
+def loads(frames: list, *, deserializers: bool = True) -> Any:
+    """Reconstruct a message from frames.
+
+    ``deserializers=False`` leaves ``Serialize`` leaves wrapped as
+    ``Serialized`` (store-and-forward without decode, reference
+    ``deserialize=False`` path)."""
+    header = msgpack.unpackb(frames[0], object_hook=_msgpack_hook, strict_map_key=False)
+    body = msgpack.unpackb(frames[1], object_hook=_msgpack_hook, strict_map_key=False)
+    payload = frames[2:]
+
+    compression = header.get("compression", [])
+    values: dict[tuple, Any] = {}
+    for sub in header.get("sub-headers", []):
+        start = sub["frame-start"]
+        splits = sub["splits"]
+        # reassemble split frames, decompressing each part
+        leaf_frames: list = []
+        idx = start
+        for nparts in splits:
+            parts = []
+            for _ in range(nparts):
+                f = decompress_frame(payload[idx], compression[idx] if idx < len(compression) else None)
+                parts.append(f)
+                idx += 1
+            if len(parts) == 1:
+                leaf_frames.append(parts[0])
+            else:
+                leaf_frames.append(b"".join(bytes(p) for p in parts))
+        path = tuple(sub["path"])
+        sub2 = {k: v for k, v in sub.items() if k not in ("path", "frame-start", "splits")}
+        if deserializers:
+            values[path] = deserialize(sub2, leaf_frames)
+        else:
+            values[path] = Serialized(sub2, leaf_frames)
+    return _plant(body, values)

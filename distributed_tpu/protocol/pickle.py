@@ -1,0 +1,37 @@
+"""Pickle shim: protocol-5 out-of-band buffers + cloudpickle fallback.
+
+Equivalent of the reference's ``distributed/protocol/pickle.py``: plain
+pickle first (fast, C implementation) with a ``buffer_callback`` collecting
+zero-copy out-of-band buffers; anything pickle can't handle (lambdas,
+closures, interactively-defined functions) falls back to cloudpickle.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = None
+
+HIGHEST_PROTOCOL = pickle.HIGHEST_PROTOCOL
+
+
+def dumps(x: Any, *, buffer_callback=None) -> bytes:
+    """Pickle with the best available serializer for ``x``."""
+    buffers: list = []
+    cb = buffers.append if buffer_callback is None else buffer_callback
+    try:
+        return pickle.dumps(x, protocol=5, buffer_callback=cb)
+    except Exception:
+        if buffer_callback is None:
+            buffers.clear()
+        if cloudpickle is None:
+            raise
+        return cloudpickle.dumps(x, protocol=5, buffer_callback=cb)
+
+
+def loads(data: bytes, *, buffers=()) -> Any:
+    return pickle.loads(data, buffers=buffers)

@@ -1,0 +1,244 @@
+"""Serializer family registry: msg -> (header, frames).
+
+Design follows the reference's ``distributed/protocol/serialize.py``:
+pluggable *families* each turn an object into a small msgpack-able header
+plus a list of zero-copy frames (buffers).  Families here:
+
+- ``pickle``   — protocol-5 pickle with out-of-band buffers (the default for
+                 opaque Python objects, covers what the reference's "dask" +
+                 "pickle" families did together)
+- ``numpy``    — zero-copy: header carries dtype/shape, frame is the raw
+                 buffer (reference protocol/numpy.py)
+- ``jax``      — device arrays: device_get to host numpy on serialize,
+                 numpy->device_put on deserialize.  This is the TPU
+                 equivalent of the reference's "cuda" family
+                 (protocol/cuda.py): accelerator-resident buffers move
+                 host-side at the comm boundary; bulk device-to-device moves
+                 ride ICI collectives instead (see distributed_tpu/shuffle).
+- ``msgpack``  — plain-data passthrough (no frames)
+- ``error``    — last resort: a repr of an unserializable object
+
+Wrappers (reference serialize.py:515-593):
+
+- ``Serialize(x)`` / ``to_serialize(x)`` — serialize when written to a comm
+- ``Serialized(header, frames)``         — already-serialized passthrough
+  (workers forward dependency data without a decode/encode round-trip)
+- ``ToPickle(x)`` — force whole-object pickle through the message channel
+  (used for task graphs)
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+from distributed_tpu.protocol import pickle as _pickle
+
+# --------------------------------------------------------------- wrappers
+
+
+class Serialize:
+    """Mark ``data`` for serialization when the message is dumped."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Any):
+        self.data = data
+
+    def __repr__(self) -> str:
+        return f"<Serialize: {self.data!r}>"
+
+    def __eq__(self, other):
+        return isinstance(other, Serialize) and other.data == self.data
+
+    def __hash__(self):
+        return hash(("Serialize", id(self.data)))
+
+
+to_serialize = Serialize
+
+
+class Serialized:
+    """Already-serialized payload: forwarded without deserializing."""
+
+    __slots__ = ("header", "frames")
+
+    def __init__(self, header: dict, frames: list):
+        self.header = header
+        self.frames = frames
+
+    def deserialize(self) -> Any:
+        return deserialize(self.header, self.frames)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Serialized)
+            and other.header == self.header
+            and other.frames == self.frames
+        )
+
+
+class ToPickle:
+    """Force pickle serialization through the msgpack channel."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Any):
+        self.data = data
+
+    def __repr__(self) -> str:
+        return f"<ToPickle: {self.data!r}>"
+
+
+class Pickled:
+    """Already-pickled payload."""
+
+    __slots__ = ("header", "frames")
+
+    def __init__(self, header: dict, frames: list):
+        self.header = header
+        self.frames = frames
+
+
+# ----------------------------------------------------- family registry
+
+families: dict[str, tuple[Callable, Callable]] = {}
+
+
+def register_serialization_family(name: str, dumps: Callable, loads: Callable) -> None:
+    """Register ``dumps(x) -> (header, frames)`` / ``loads(header, frames) -> x``
+    (reference serialize.py:191)."""
+    families[name] = (dumps, loads)
+
+
+def _pickle_dumps(x: Any) -> tuple[dict, list]:
+    buffers: list = []
+    data = _pickle.dumps(x, buffer_callback=buffers.append)
+    frames = [data] + [bytes(b) if not isinstance(b, (bytes, memoryview)) else b
+                       for b in buffers]
+    return {"serializer": "pickle", "num-buffers": len(buffers)}, frames
+
+
+def _pickle_loads(header: dict, frames: list) -> Any:
+    return _pickle.loads(bytes(frames[0]) if not isinstance(frames[0], bytes) else frames[0],
+                         buffers=frames[1:])
+
+
+register_serialization_family("pickle", _pickle_dumps, _pickle_loads)
+
+
+def _numpy_dumps(x) -> tuple[dict, list]:
+    import numpy as np
+
+    x = np.ascontiguousarray(x)
+    header = {
+        "serializer": "numpy",
+        "dtype": x.dtype.str,
+        "shape": list(x.shape),
+    }
+    return header, [x.data.cast("B")]
+
+
+def _numpy_loads(header: dict, frames: list):
+    import numpy as np
+
+    buf = frames[0]
+    arr = np.frombuffer(buf, dtype=np.dtype(header["dtype"]))
+    return arr.reshape(header["shape"])
+
+
+register_serialization_family("numpy", _numpy_dumps, _numpy_loads)
+
+
+def _jax_dumps(x) -> tuple[dict, list]:
+    import numpy as np
+
+    host = np.asarray(x)  # device_get; dlpack zero-copy when already on host
+    header, frames = _numpy_dumps(host)
+    header["serializer"] = "jax"
+    # weak_type/committed intentionally dropped: data-plane values
+    return header, frames
+
+
+def _jax_loads(header: dict, frames: list):
+    import jax.numpy as jnp
+
+    return jnp.asarray(_numpy_loads(header, frames))
+
+
+register_serialization_family("jax", _jax_dumps, _jax_loads)
+
+
+def _error_dumps(x: Any) -> tuple[dict, list]:
+    return {"serializer": "error"}, [repr(x).encode()[:10_000]]
+
+
+def _error_loads(header: dict, frames: list) -> Any:
+    raise TypeError(f"Could not deserialize object: {bytes(frames[0])!r}")
+
+
+register_serialization_family("error", _error_dumps, _error_loads)
+
+
+def _family_for(x: Any) -> str:
+    # dispatch by type without importing heavyweight modules
+    mod = type(x).__module__
+    if mod == "numpy":
+        import numpy as np
+
+        if isinstance(x, np.ndarray) and x.dtype != object:
+            return "numpy"
+    if mod.startswith("jax") or mod.startswith("jaxlib"):
+        try:
+            import jax
+
+            if isinstance(x, jax.Array):
+                return "jax"
+        except ImportError:  # pragma: no cover
+            pass
+    return "pickle"
+
+
+def serialize(x: Any, serializers: tuple[str, ...] | None = None) -> tuple[dict, list]:
+    """Serialize one object -> (header, frames).  ``frames`` may contain
+    memoryviews (zero-copy out-of-band buffers)."""
+    if isinstance(x, Serialized):
+        return x.header, x.frames
+    if isinstance(x, Serialize):
+        x = x.data
+    name = _family_for(x)
+    if serializers is not None and name not in serializers:
+        name = serializers[0]
+    dumps, _ = families[name]
+    try:
+        return dumps(x)
+    except Exception:
+        if name != "pickle" and (serializers is None or "pickle" in serializers):
+            try:
+                return families["pickle"][0](x)
+            except Exception:
+                pass
+        return families["error"][0](x)
+
+
+def deserialize(header: dict, frames: list) -> Any:
+    name = header.get("serializer", "pickle")
+    try:
+        _, loads = families[name]
+    except KeyError:
+        raise ValueError(f"unknown serializer family {name!r}") from None
+    return loads(header, frames)
+
+
+def nested_deserialize(obj: Any) -> Any:
+    """Replace Serialize/Serialized wrappers in a message with their values."""
+    if isinstance(obj, dict):
+        return {k: nested_deserialize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        vals = [nested_deserialize(v) for v in obj]
+        return type(obj)(vals) if isinstance(obj, tuple) else vals
+    if isinstance(obj, Serialize):
+        return obj.data
+    if isinstance(obj, Serialized):
+        return obj.deserialize()
+    return obj

@@ -1,0 +1,108 @@
+"""BatchedSend: coalesce many tiny messages onto one comm.
+
+Reference batched.py:20 — the scheduler<->worker and scheduler<->client
+event streams each push hundreds of tiny dicts per second; sending each in
+its own write would syscall-storm.  ``send()`` appends to a buffer; a
+background loop flushes the whole buffer as one list every ``interval``
+(2-5 ms), waiting for the comm between flushes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Any
+
+from distributed_tpu.comm.core import Comm
+from distributed_tpu.exceptions import CommClosedError
+
+logger = logging.getLogger("distributed_tpu.rpc")
+
+
+class BatchedSend:
+    def __init__(self, interval: float = 0.002):
+        self.interval = interval
+        self.buffer: deque = deque()
+        self.comm: Comm | None = None
+        self.please_stop = False
+        self.waker = asyncio.Event()
+        self.stopped = asyncio.Event()
+        self.stopped.set()
+        self._background_task: asyncio.Task | None = None
+        self.byte_count = 0
+        self.batch_count = 0
+
+    def start(self, comm: Comm) -> None:
+        if self._background_task is not None and not self._background_task.done():
+            raise RuntimeError("BatchedSend already running")
+        self.comm = comm
+        self.please_stop = False
+        self.stopped.clear()
+        self.waker.set()
+        self._background_task = asyncio.create_task(self._background_send())
+
+    def closed(self) -> bool:
+        return self.comm is None or self.comm.closed
+
+    def send(self, *msgs: Any) -> None:
+        """Enqueue; raises if the stream was closed."""
+        if self.comm is not None and self.comm.closed:
+            raise CommClosedError(f"comm {self.comm!r} already closed")
+        self.buffer.extend(msgs)
+        self.waker.set()
+
+    async def _background_send(self) -> None:
+        try:
+            while not self.please_stop:
+                try:
+                    await asyncio.wait_for(self.waker.wait(), self.interval)
+                except asyncio.TimeoutError:
+                    pass
+                self.waker.clear()
+                if not self.buffer:
+                    if self.please_stop:
+                        break
+                    continue
+                payload, self.buffer = list(self.buffer), deque()
+                try:
+                    nbytes = await self.comm.write(payload)
+                    self.byte_count += nbytes
+                    self.batch_count += 1
+                except CommClosedError:
+                    # retain the payload for a possible restart on a new comm
+                    payload.extend(self.buffer)
+                    self.buffer = deque(payload)
+                    break
+        finally:
+            self.stopped.set()
+
+    async def close(self, timeout: float | None = None) -> None:
+        """Flush and close the comm."""
+        self.please_stop = True
+        self.waker.set()
+        if self._background_task is not None:
+            try:
+                await asyncio.wait_for(self.stopped.wait(), timeout)
+            except asyncio.TimeoutError:
+                self._background_task.cancel()
+        if self.comm is not None and not self.comm.closed:
+            try:
+                if self.buffer:
+                    payload, self.buffer = list(self.buffer), deque()
+                    await self.comm.write(payload)
+            except CommClosedError:
+                pass
+            await self.comm.close()
+
+    def abort(self) -> None:
+        self.please_stop = True
+        self.buffer.clear()
+        self.waker.set()
+        if self.comm is not None and not self.comm.closed:
+            self.comm.abort()
+
+    def __repr__(self) -> str:
+        n = len(self.buffer)
+        state = "closed" if self.closed() else "open"
+        return f"<BatchedSend {state}: {n} buffered>"
