@@ -31,11 +31,11 @@ def __getattr__(name: str):
 
         return getattr(_c, name)
     if name == "Scheduler":
-        from distributed_tpu.scheduler.scheduler import Scheduler
+        from distributed_tpu.scheduler.server import Scheduler
 
         return Scheduler
     if name == "Worker":
-        from distributed_tpu.worker.worker import Worker
+        from distributed_tpu.worker.server import Worker
 
         return Worker
     if name == "Nanny":

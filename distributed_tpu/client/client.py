@@ -1,0 +1,736 @@
+"""Client: futures, submit/map/gather/scatter (reference client.py).
+
+The client keeps one batched stream to the scheduler; ``_handle_report``
+dispatches ``key-in-memory`` / ``task-erred`` / ``lost-data`` report
+messages onto client-side ``Future`` objects, which are refcounted so the
+scheduler can release results nobody holds anymore
+(reference client.py:174,741,1548).
+
+Async-first: every API is a coroutine on the running event loop; the
+sync facade (``Client(..., asynchronous=False)``) drives a dedicated
+loop thread via ``LoopRunner`` like the reference's ``SyncMethodMixin``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from collections.abc import Iterable, Iterator
+from typing import Any, Callable
+
+from distributed_tpu.comm.core import Comm, connect
+from distributed_tpu.exceptions import CommClosedError
+from distributed_tpu.graph.spec import Graph, Key, TaskRef, TaskSpec, tokenize
+from distributed_tpu.protocol.serialize import Serialize, ToPickle, unwrap
+from distributed_tpu.rpc.batched import BatchedSend
+from distributed_tpu.rpc.core import raise_remote_error, rpc
+from distributed_tpu.utils.misc import LoopRunner, funcname, seq_name
+
+logger = logging.getLogger("distributed_tpu.client")
+
+
+class FutureState:
+    """Client-side record of one key's lifecycle."""
+
+    __slots__ = ("event", "status", "type", "exception", "traceback", "traceback_text")
+
+    def __init__(self) -> None:
+        self.event = asyncio.Event()
+        self.status = "pending"
+        self.type: str | None = None
+        self.exception: BaseException | None = None
+        self.traceback: Any = None
+        self.traceback_text = ""
+
+    def finish(self, type: str | None = None) -> None:
+        self.status = "finished"
+        self.type = type
+        self.event.set()
+
+    def lose(self) -> None:
+        self.status = "lost"
+        self.event.clear()
+
+    def set_error(self, exception: BaseException, traceback: Any,
+                  traceback_text: str = "") -> None:
+        self.status = "error"
+        self.exception = exception
+        self.traceback = traceback
+        self.traceback_text = traceback_text
+        self.event.set()
+
+    def cancel(self) -> None:
+        self.status = "cancelled"
+        self.exception = asyncio.CancelledError()
+        self.event.set()
+
+
+class Future:
+    """A remote result (reference client.py:174)."""
+
+    def __init__(self, key: Key, client: "Client"):
+        self.key = key
+        self.client = client
+        self._cleared = False
+        client._inc_ref(key)
+
+    @property
+    def _state(self) -> FutureState:
+        return self.client.futures[self.key]
+
+    @property
+    def status(self) -> str:
+        st = self.client.futures.get(self.key)
+        return st.status if st is not None else "cancelled"
+
+    def done(self) -> bool:
+        st = self.client.futures.get(self.key)
+        return st is not None and st.event.is_set()
+
+    def cancelled(self) -> bool:
+        return self.status == "cancelled"
+
+    async def result(self, timeout: float | None = None):
+        """Wait for and fetch the value (async; the sync shell wraps this)."""
+        return await self.client._result(self, timeout=timeout)
+
+    async def exception(self, timeout: float | None = None):
+        st = self.client.futures.get(self.key)
+        if st is None:
+            return None
+        await asyncio.wait_for(st.event.wait(), timeout)
+        return st.exception
+
+    async def traceback(self, timeout: float | None = None):
+        st = self.client.futures.get(self.key)
+        if st is None:
+            return None
+        await asyncio.wait_for(st.event.wait(), timeout)
+        return st.traceback
+
+    async def cancel(self):
+        await self.client.cancel([self])
+
+    def release(self) -> None:
+        if not self._cleared:
+            self._cleared = True
+            self.client._dec_ref(self.key)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return f"<Future: {self.status}, key: {self.key}>"
+
+    def __await__(self):
+        return self.result().__await__()
+
+    def __hash__(self) -> int:
+        return hash(self.key)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Future) and other.key == self.key
+
+
+class Client:
+    """Entry point for users (reference client.py:741)."""
+
+    def __init__(
+        self,
+        address: str | None = None,
+        *,
+        asynchronous: bool = True,
+        name: str | None = None,
+        timeout: float = 10.0,
+        heartbeat_interval: float | None = None,
+    ):
+        self.address = address
+        self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
+        self.futures: dict[Key, FutureState] = {}
+        self.refcount: dict[Key, int] = {}
+        self.scheduler_comm: Comm | None = None
+        self.batched_stream = BatchedSend(interval=0.002)
+        self.scheduler: rpc | None = None
+        self.status = "newly-created"
+        self.asynchronous = asynchronous
+        self._timeout = timeout
+        self._handle_report_task: asyncio.Task | None = None
+        self._generation = 0
+        self._loop_runner: LoopRunner | None = None
+        if not asynchronous:
+            self._loop_runner = LoopRunner()
+            self._loop_runner.start()
+            self.sync(self._start)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def sync(self, coro_fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        assert self._loop_runner is not None
+        return self._loop_runner.run_sync(coro_fn, *args, **kwargs)
+
+    async def _start(self) -> "Client":
+        comm = await connect(self.address)
+        await comm.write(
+            {"op": "register-client", "client": self.id, "reply": False}
+        )
+        resp = await comm.read()
+        if resp.get("status") != "OK":
+            raise ValueError(f"scheduler rejected client: {resp!r}")
+        self.scheduler_comm = comm
+        self.batched_stream.start(comm)
+        self.scheduler = rpc(self.address)
+        self._handle_report_task = asyncio.create_task(self._handle_report())
+        self.status = "running"
+        logger.info("%s connected to %s", self.id, self.address)
+        return self
+
+    async def __aenter__(self) -> "Client":
+        if self.status == "newly-created":
+            await self._start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.sync(self.close)
+        if self._loop_runner is not None:
+            self._loop_runner.stop()
+
+    async def close(self) -> None:
+        if self.status == "closed":
+            return
+        self.status = "closed"
+        if self._handle_report_task is not None:
+            self._handle_report_task.cancel()
+            try:
+                await self._handle_report_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        try:
+            if not self.batched_stream.closed():
+                self.batched_stream.send({"op": "close-client", "client": self.id})
+                self.batched_stream.send({"op": "close-stream"})
+        except CommClosedError:
+            pass
+        await self.batched_stream.close(timeout=1)
+        if self.scheduler_comm is not None:
+            await self.scheduler_comm.close()
+        if self.scheduler is not None:
+            await self.scheduler.close_rpc()
+        for st in self.futures.values():
+            if not st.event.is_set():
+                st.cancel()
+
+    # ------------------------------------------------------- report stream
+
+    async def _handle_report(self) -> None:
+        """Dispatch scheduler report messages (reference client.py:1548)."""
+        assert self.scheduler_comm is not None
+        try:
+            while True:
+                msgs = await self.scheduler_comm.read()
+                if not isinstance(msgs, (list, tuple)):
+                    msgs = (msgs,)
+                for msg in msgs:
+                    if msg == "OK":
+                        continue
+                    op = msg.pop("op", None)
+                    if op == "key-in-memory":
+                        self._handle_key_in_memory(**msg)
+                    elif op == "task-erred":
+                        self._handle_task_erred(**msg)
+                    elif op == "lost-data":
+                        self._handle_lost_data(**msg)
+                    elif op == "cancelled-keys":
+                        for key in msg.get("keys", ()):
+                            st = self.futures.get(key)
+                            if st is not None:
+                                st.cancel()
+                    elif op in ("stream-closed", "close", "restart"):
+                        if op == "restart":
+                            for st in self.futures.values():
+                                st.cancel()
+                        if op != "restart":
+                            return
+        except (CommClosedError, asyncio.CancelledError):
+            pass
+        finally:
+            if self.status == "running":
+                self.status = "connection-lost"
+                for st in self.futures.values():
+                    if not st.event.is_set():
+                        st.set_error(
+                            CommClosedError("lost connection to scheduler"), None
+                        )
+
+    def _handle_key_in_memory(self, key: Key = "", type: str | None = None,
+                              **kw: Any) -> None:
+        st = self.futures.get(key)
+        if st is not None:
+            st.finish(type=type)
+
+    def _handle_task_erred(self, key: Key = "", exception: Any = None,
+                           traceback: Any = None, **kw: Any) -> None:
+        st = self.futures.get(key)
+        if st is not None:
+            exc = unwrap(exception)
+            if not isinstance(exc, BaseException):
+                exc = Exception(str(exc))
+            st.set_error(exc, unwrap(traceback), kw.get("traceback_text", ""))
+
+    def _handle_lost_data(self, key: Key = "", **kw: Any) -> None:
+        st = self.futures.get(key)
+        if st is not None:
+            st.lose()
+
+    # ---------------------------------------------------------- refcounting
+
+    def _inc_ref(self, key: Key) -> None:
+        self.refcount[key] = self.refcount.get(key, 0) + 1
+
+    def _dec_ref(self, key: Key) -> None:
+        n = self.refcount.get(key, 0) - 1
+        if n <= 0:
+            self.refcount.pop(key, None)
+            self.futures.pop(key, None)
+            if self.status == "running" and not self.batched_stream.closed():
+                try:
+                    self.batched_stream.send(
+                        {
+                            "op": "client-releases-keys",
+                            "keys": [key],
+                            "client": self.id,
+                        }
+                    )
+                except CommClosedError:
+                    pass
+        else:
+            self.refcount[key] = n
+
+    # ------------------------------------------------------------ submission
+
+    def _graph_to_futures(
+        self,
+        tasks: dict[Key, Any],
+        keys: list[Key],
+        *,
+        priority: int = 0,
+        workers: list[str] | str | None = None,
+        allow_other_workers: bool = False,
+        resources: dict | None = None,
+        retries: int | None = None,
+        actors: Any = False,
+    ) -> dict[Key, Future]:
+        """Ship a graph, returning futures for ``keys``
+        (reference client.py:3098)."""
+        deps = {
+            k: sorted(spec.dependencies()) if isinstance(spec, TaskSpec) else []
+            for k, spec in tasks.items()
+        }
+        annotations: dict[Key, dict] = {}
+        ann: dict[str, Any] = {}
+        if workers is not None:
+            ann["workers"] = workers
+            if allow_other_workers:
+                ann["allow_other_workers"] = True
+        if resources:
+            ann["resources"] = resources
+        if retries:
+            ann["retries"] = retries
+        if ann:
+            annotations = {k: ann for k in tasks}
+        futures: dict[Key, Future] = {}
+        for key in keys:
+            if key not in self.futures:
+                self.futures[key] = FutureState()
+            futures[key] = Future(key, self)
+        self._generation += 1
+        self.batched_stream.send(
+            {
+                "op": "update-graph",
+                "client": self.id,
+                "tasks": ToPickle(tasks),
+                "dependencies": deps,
+                "keys": list(keys),
+                "user_priority": priority,
+                "annotations_by_key": annotations or None,
+                "actors": actors,
+                "stimulus_id": seq_name("update-graph"),
+            }
+        )
+        return futures
+
+    def submit(
+        self,
+        fn: Callable,
+        *args: Any,
+        key: Key | None = None,
+        pure: bool = True,
+        priority: int = 0,
+        workers: list[str] | str | None = None,
+        allow_other_workers: bool = False,
+        resources: dict | None = None,
+        retries: int | None = None,
+        actor: bool = False,
+        **kwargs: Any,
+    ) -> Future:
+        """Run ``fn(*args, **kwargs)`` on the cluster (reference client.py:1828)."""
+        if key is None:
+            if pure and not actor:
+                key = f"{funcname(fn)}-{tokenize(fn, args, tuple(sorted(kwargs.items())))}"
+            else:
+                key = f"{funcname(fn)}-{uuid.uuid4().hex[:16]}"
+        if key in self.futures:
+            return Future(key, self)
+        spec_args = _futures_to_refs(args)
+        spec_kwargs = _futures_to_refs(kwargs)
+        tasks: dict[Key, Any] = {key: TaskSpec(fn, spec_args, spec_kwargs)}
+        futs = self._graph_to_futures(
+            tasks, [key], priority=priority, workers=workers,
+            allow_other_workers=allow_other_workers, resources=resources,
+            retries=retries, actors=[key] if actor else False,
+        )
+        return futs[key]
+
+    def map(
+        self,
+        fn: Callable,
+        *iterables: Iterable,
+        key: str | None = None,
+        pure: bool = True,
+        priority: int = 0,
+        workers: list[str] | str | None = None,
+        resources: dict | None = None,
+        retries: int | None = None,
+        **kwargs: Any,
+    ) -> list[Future]:
+        """Map a function over argument lists (reference client.py:1967)."""
+        iterables = tuple(list(it) for it in iterables)
+        prefix = key or funcname(fn)
+        tasks: dict[Key, Any] = {}
+        keys: list[Key] = []
+        for i, zargs in enumerate(zip(*iterables)):
+            if pure:
+                k = f"{prefix}-{tokenize(fn, zargs, tuple(sorted(kwargs.items())))}"
+            else:
+                k = f"{prefix}-{uuid.uuid4().hex[:16]}"
+            keys.append(k)
+            if k in self.futures or k in tasks:
+                continue
+            tasks[k] = TaskSpec(fn, _futures_to_refs(zargs), _futures_to_refs(kwargs))
+        futs = self._graph_to_futures(
+            {k: v for k, v in tasks.items()},
+            [k for k in dict.fromkeys(keys)],
+            priority=priority, workers=workers, resources=resources,
+            retries=retries,
+        )
+        return [futs.get(k) or Future(k, self) for k in keys]
+
+    def compute_graph(self, graph: Graph, keys: list[Key], **kwargs: Any
+                      ) -> dict[Key, Future]:
+        """Submit a pre-built ``Graph`` (the collections entry point)."""
+        graph.validate()
+        return self._graph_to_futures(dict(graph.tasks), keys, **kwargs)
+
+    # ------------------------------------------------------------- results
+
+    async def _result(self, future: Future, timeout: float | None = None) -> Any:
+        st = self.futures.get(future.key)
+        if st is None:
+            raise asyncio.CancelledError(future.key)
+        await asyncio.wait_for(st.event.wait(), timeout)
+        if st.status == "error":
+            assert st.exception is not None
+            raise st.exception
+        if st.status == "cancelled":
+            raise asyncio.CancelledError(future.key)
+        data = await self._gather_keys([future.key])
+        return data[future.key]
+
+    async def gather(self, futures: Any, errors: str = "raise") -> Any:
+        """Wait for and download many futures (reference client.py:2317);
+        preserves the nesting structure of ``futures``."""
+        flat: list[Future] = []
+        _collect_futures(futures, flat)
+        # wait for completion
+        for f in flat:
+            st = self.futures.get(f.key)
+            if st is None:
+                if errors == "skip":
+                    continue
+                raise asyncio.CancelledError(f.key)
+            await st.event.wait()
+            if st.status == "error" and errors == "raise":
+                assert st.exception is not None
+                raise st.exception
+            if st.status == "cancelled" and errors == "raise":
+                raise asyncio.CancelledError(f.key)
+        keys = [
+            f.key
+            for f in flat
+            if (st := self.futures.get(f.key)) is not None
+            and st.status == "finished"
+        ]
+        data = await self._gather_keys(list(dict.fromkeys(keys)))
+        return _substitute_futures(futures, data, errors)
+
+    async def _gather_keys(self, keys: list[Key]) -> dict[Key, Any]:
+        if not keys:
+            return {}
+        assert self.scheduler is not None
+        attempts = 3
+        for attempt in range(attempts):
+            resp = await self.scheduler.gather(keys=keys)
+            if resp.get("status") == "OK":
+                return {k: unwrap(v) for k, v in resp["data"].items()}
+            missing = resp.get("keys", [])
+            logger.warning("gather attempt %d missing %s", attempt, missing)
+            await asyncio.sleep(0.1 * (attempt + 1))
+        raise KeyError(f"could not gather keys: {missing}")
+
+    async def scatter(
+        self,
+        data: Any,
+        workers: list[str] | None = None,
+        broadcast: bool = False,
+        hash: bool = True,
+    ) -> Any:
+        """Push local data into cluster memory (reference client.py:2486)."""
+        unpack_single = False
+        if isinstance(data, dict):
+            named = {str(k): v for k, v in data.items()}
+        else:
+            if not isinstance(data, (list, tuple, set)):
+                data = [data]
+                unpack_single = True
+            named = {}
+            for v in data:
+                if hash:
+                    k = f"{type(v).__name__}-{tokenize_data(v)}"
+                else:
+                    k = f"{type(v).__name__}-{uuid.uuid4().hex[:16]}"
+                named[k] = v
+        assert self.scheduler is not None
+        for key in named:
+            if key not in self.futures:
+                self.futures[key] = FutureState()
+        keys = await self.scheduler.scatter(
+            data={k: Serialize(v) for k, v in named.items()},
+            client=self.id,
+            workers=workers,
+            broadcast=broadcast,
+        )
+        futs = {}
+        for k in keys:
+            self.futures[k].finish()
+            futs[k] = Future(k, self)
+        if isinstance(data, dict):
+            return futs
+        out = [futs[k] for k in named if k in futs]
+        return out[0] if unpack_single else out
+
+    async def cancel(self, futures: Iterable[Future], force: bool = False) -> None:
+        keys = [f.key for f in futures]
+        assert self.scheduler is not None
+        await self.scheduler.cancel(keys=keys, client=self.id, force=force)
+
+    async def retry(self, futures: Iterable[Future]) -> None:
+        keys = []
+        for f in futures:
+            st = self.futures.get(f.key)
+            if st is not None:
+                st.status = "pending"
+                st.event.clear()
+                st.exception = None
+            keys.append(f.key)
+        assert self.scheduler is not None
+        await self.scheduler.retry(keys=keys, client=self.id)
+
+    # ------------------------------------------------------------ cluster ops
+
+    async def run(self, fn: Callable, *args: Any,
+                  workers: list[str] | None = None, wait: bool = True,
+                  **kwargs: Any) -> dict:
+        """Run a function on workers outside the task system
+        (reference client.py:2904)."""
+        assert self.scheduler is not None
+        resp = await self.scheduler.broadcast(
+            msg={
+                "op": "run",
+                "function": Serialize(fn),
+                "args": Serialize(args),
+                "kwargs": Serialize(kwargs),
+                "wait": wait,
+            },
+            workers=workers,
+        )
+        out = {}
+        for addr, r in resp.items():
+            if isinstance(r, dict) and r.get("status") == "error":
+                raise_remote_error(r)
+            out[addr] = unwrap(r.get("result")) if isinstance(r, dict) else r
+        return out
+
+    async def run_on_scheduler(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        assert self.scheduler is not None
+        resp = await self.scheduler.run_function(
+            function=Serialize(fn), args=Serialize(args), kwargs=Serialize(kwargs)
+        )
+        if resp.get("status") == "error":
+            raise_remote_error(resp)
+        return unwrap(resp.get("result"))
+
+    async def restart(self) -> None:
+        assert self.scheduler is not None
+        await self.scheduler.restart()
+        for st in self.futures.values():
+            st.cancel()
+
+    async def who_has(self, futures: Iterable[Future] | None = None) -> dict:
+        assert self.scheduler is not None
+        keys = [f.key for f in futures] if futures is not None else None
+        return await self.scheduler.who_has(keys=keys)
+
+    async def has_what(self, workers: list[str] | None = None) -> dict:
+        assert self.scheduler is not None
+        return await self.scheduler.has_what(workers=workers)
+
+    async def ncores(self, workers: list[str] | None = None) -> dict:
+        assert self.scheduler is not None
+        return await self.scheduler.ncores(workers=workers)
+
+    nthreads = ncores
+
+    async def scheduler_info(self) -> dict:
+        assert self.scheduler is not None
+        return await self.scheduler.identity()
+
+    def __repr__(self) -> str:
+        return f"<Client {self.id!r} {self.status} scheduler={self.address!r}>"
+
+
+# ------------------------------------------------------------ helpers
+
+
+def _futures_to_refs(obj: Any) -> Any:
+    """Deep-replace Future objects with TaskRef markers."""
+    if isinstance(obj, Future):
+        return TaskRef(obj.key)
+    if isinstance(obj, tuple):
+        return tuple(_futures_to_refs(o) for o in obj)
+    if isinstance(obj, list):
+        return [_futures_to_refs(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _futures_to_refs(v) for k, v in obj.items()}
+    return obj
+
+
+def _collect_futures(obj: Any, out: list[Future]) -> None:
+    if isinstance(obj, Future):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple, set)):
+        for o in obj:
+            _collect_futures(o, out)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_futures(v, out)
+
+
+def _substitute_futures(obj: Any, data: dict[Key, Any], errors: str) -> Any:
+    if isinstance(obj, Future):
+        return data.get(obj.key)
+    if isinstance(obj, tuple):
+        return tuple(_substitute_futures(o, data, errors) for o in obj)
+    if isinstance(obj, list):
+        return [_substitute_futures(o, data, errors) for o in obj]
+    if isinstance(obj, set):
+        return {_substitute_futures(o, data, errors) for o in obj}
+    if isinstance(obj, dict):
+        return {k: _substitute_futures(v, data, errors) for k, v in obj.items()}
+    return obj
+
+
+def tokenize_data(v: Any) -> str:
+    return tokenize(type(v).__name__, repr(v)[:1000])
+
+
+async def wait(futures: Any, timeout: float | None = None,
+               return_when: str = "ALL_COMPLETED") -> Any:
+    """Block until futures finish (reference client.py wait)."""
+    flat: list[Future] = []
+    _collect_futures(futures, flat)
+
+    async def _one(f: Future):
+        st = f.client.futures.get(f.key)
+        if st is not None:
+            await st.event.wait()
+        return f
+
+    if return_when == "FIRST_COMPLETED":
+        done_set, pending = set(), set(flat)
+        tasks = {asyncio.ensure_future(_one(f)): f for f in flat}
+        done, not_done = await asyncio.wait(
+            tasks, timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in not_done:
+            t.cancel()
+        for t in done:
+            done_set.add(tasks[t])
+            pending.discard(tasks[t])
+        return _DoneAndNotDone(done_set, pending)
+    await asyncio.wait_for(
+        asyncio.gather(*(_one(f) for f in flat)), timeout
+    )
+    return _DoneAndNotDone(set(flat), set())
+
+
+class _DoneAndNotDone:
+    def __init__(self, done: set, not_done: set):
+        self.done = done
+        self.not_done = not_done
+
+
+class as_completed:
+    """Iterate over futures in completion order (reference client.py:~5600)."""
+
+    def __init__(self, futures: Iterable[Future] = (), *, with_results: bool = False):
+        self.with_results = with_results
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.count = 0
+        for f in futures:
+            self.add(f)
+
+    def add(self, future: Future) -> None:
+        self.count += 1
+
+        async def _watch(f: Future = future):
+            st = f.client.futures.get(f.key)
+            if st is not None:
+                await st.event.wait()
+            if self.with_results:
+                try:
+                    result = await f.result()
+                except BaseException as e:  # noqa: B036
+                    result = e
+                await self.queue.put((f, result))
+            else:
+                await self.queue.put(f)
+
+        asyncio.ensure_future(_watch())
+
+    def __aiter__(self) -> "as_completed":
+        return self
+
+    async def __anext__(self):
+        if self.count == 0:
+            raise StopAsyncIteration
+        self.count -= 1
+        return await self.queue.get()

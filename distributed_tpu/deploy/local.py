@@ -1,0 +1,100 @@
+"""LocalCluster: scheduler + workers in one process.
+
+Equivalent of the reference's ``LocalCluster(processes=False)``
+(deploy/local.py:23): the scheduler and every worker are Server objects
+sharing one event loop, talking over ``inproc://`` comms — the workhorse
+for tests and single-host use.  Multi-process workers arrive with the
+Nanny (deploy/spec.py equivalent).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
+
+logger = logging.getLogger("distributed_tpu.deploy")
+
+
+class LocalCluster:
+    """In-process cluster (reference deploy/local.py:23)."""
+
+    def __init__(
+        self,
+        n_workers: int = 2,
+        threads_per_worker: int = 1,
+        *,
+        protocol: str = "inproc",
+        scheduler_kwargs: dict | None = None,
+        worker_kwargs: dict | None = None,
+    ):
+        self.n_workers = n_workers
+        self.threads_per_worker = threads_per_worker
+        self.protocol = protocol
+        self.scheduler = Scheduler(
+            listen_addr=f"{protocol}://" if protocol == "inproc" else None,
+            **(scheduler_kwargs or {}),
+        )
+        self._worker_kwargs = worker_kwargs or {}
+        self.workers: list[Worker] = []
+        self._started = False
+
+    @property
+    def scheduler_address(self) -> str:
+        return self.scheduler.address
+
+    async def _start(self) -> "LocalCluster":
+        if self._started:
+            return self
+        await self.scheduler.start()
+        for i in range(self.n_workers):
+            await self.add_worker(name=f"worker-{i}")
+        self._started = True
+        return self
+
+    async def add_worker(self, name: str | None = None, **kwargs: Any) -> Worker:
+        kw = {**self._worker_kwargs, **kwargs}
+        kw.setdefault("nthreads", self.threads_per_worker)
+        if self.protocol == "inproc":
+            kw.setdefault("listen_addr", "inproc://")
+        worker = Worker(self.scheduler.address, name=name, **kw)
+        await worker.start()
+        self.workers.append(worker)
+        return worker
+
+    async def scale(self, n: int) -> None:
+        """Grow or shrink to ``n`` workers."""
+        while len(self.workers) < n:
+            await self.add_worker(name=f"worker-{len(self.workers)}")
+        if len(self.workers) > n:
+            victims = self.workers[n:]
+            self.workers = self.workers[:n]
+            await self.scheduler.retire_workers(
+                workers=[w.address for w in victims]
+            )
+            for w in victims:
+                await w.finished()
+
+    def get_client(self) -> Client:
+        return Client(self.scheduler.address)
+
+    async def close(self) -> None:
+        for worker in self.workers:
+            await worker.close()
+        self.workers.clear()
+        await self.scheduler.close()
+
+    async def __aenter__(self) -> "LocalCluster":
+        return await self._start()
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+    def __repr__(self) -> str:
+        return (
+            f"<LocalCluster {len(self.workers)} workers, "
+            f"scheduler={self.scheduler!r}>"
+        )

@@ -100,6 +100,20 @@ class Pickled:
         self.frames = frames
 
 
+def unwrap(obj: Any) -> Any:
+    """Undo protocol wrappers that survive an in-process hop.
+
+    Over tcp the comm layer serializes ``Serialize``/``ToPickle`` leaves and
+    the reader gets plain values; over inproc the wrapper object itself
+    arrives.  Consumption points call this to accept both.
+    """
+    if isinstance(obj, (Serialize, ToPickle)):
+        return obj.data
+    if isinstance(obj, (Serialized, Pickled)):
+        return deserialize(obj.header, obj.frames)
+    return obj
+
+
 # ----------------------------------------------------- family registry
 
 families: dict[str, tuple[Callable, Callable]] = {}

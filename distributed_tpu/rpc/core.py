@@ -93,10 +93,14 @@ class AsyncTaskGroup:
 
     async def stop(self) -> None:
         self.close()
-        for t in list(self._ongoing):
+        # never cancel the caller: close() itself often runs inside this
+        # group (terminate RPC, close-worker stream op, idle-timeout)
+        me = asyncio.current_task()
+        pending = [t for t in self._ongoing if t is not me]
+        for t in pending:
             t.cancel()
-        if self._ongoing:
-            await asyncio.gather(*self._ongoing, return_exceptions=True)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
 
     def __len__(self) -> int:
         return len(self._ongoing)
@@ -204,6 +208,7 @@ class Server:
         self.counters: dict[str, int] = {}
         self.digests: dict[str, float] = {}
         self._startup_lock = asyncio.Lock()
+        self._close_started = False
         self._event_finished = asyncio.Event()
         self.rpc = ConnectionPool(
             deserialize=deserialize,
@@ -271,9 +276,12 @@ class Server:
         await self._event_finished.wait()
 
     async def close(self, timeout: float | None = None) -> None:
-        if self.status in (Status.closed, Status.closing):
+        # guarded by a flag, not status: subclasses set status=closing and
+        # then delegate here, which must still run exactly once
+        if self._close_started:
             await self._event_finished.wait()
             return
+        self._close_started = True
         self.status = Status.closing
         for pc in self.periodic_callbacks.values():
             pc.stop()
@@ -373,7 +381,9 @@ class Server:
                         logger.error("unknown stream op %r", op)
                         continue
                     try:
-                        result = handler(**msg, **extra)
+                        # stream context (worker=/client= address) fills in
+                        # unless the message already carries the field
+                        result = handler(**{**extra, **msg})
                         if inspect.isawaitable(result):
                             await result
                     except Exception:

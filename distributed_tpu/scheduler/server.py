@@ -1,0 +1,857 @@
+"""Scheduler server: the async shell around ``SchedulerState``.
+
+Equivalent of the reference's ``Scheduler`` (scheduler.py:3453) =
+``SchedulerState`` + ``ServerNode``: RPC handler table
+(scheduler.py:3794), batched streams to every worker and client, and
+``send_all`` routing the (client_msgs, worker_msgs) produced by the pure
+state machine onto those streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Iterable
+
+from distributed_tpu import config
+from distributed_tpu.comm.core import Comm
+from distributed_tpu.exceptions import CommClosedError
+from distributed_tpu.graph.spec import Key
+from distributed_tpu.protocol.serialize import Serialize, unwrap
+from distributed_tpu.rpc.batched import BatchedSend
+from distributed_tpu.rpc.core import (
+    PeriodicCallback,
+    Server,
+    Status,
+    error_message,
+)
+from distributed_tpu.scheduler.state import SchedulerState, WorkerState
+from distributed_tpu.utils.comm import gather_from_workers, scatter_to_workers
+from distributed_tpu.utils.misc import seq_name, time
+
+logger = logging.getLogger("distributed_tpu.scheduler")
+
+
+class Scheduler(Server):
+    """Central control plane (reference scheduler.py:3453)."""
+
+    default_port = 8786
+
+    def __init__(
+        self,
+        *,
+        listen_addr: str | None = None,
+        validate: bool | None = None,
+        transition_counter_max: int | None = None,
+        placement: Any | None = None,
+        extensions: dict[str, Any] | None = None,
+        worker_ttl: float | None = None,
+        idle_timeout: float | None = None,
+        **server_kwargs: Any,
+    ):
+        self._listen_addr = listen_addr
+        self.state = SchedulerState(
+            validate=validate,
+            transition_counter_max=transition_counter_max,
+            placement=placement,
+        )
+        self.generation = 0
+        # address -> BatchedSend for workers; client key -> BatchedSend
+        self.stream_comms: dict[str, BatchedSend] = {}
+        self.client_comms: dict[str, BatchedSend] = {}
+        self.worker_ttl = (
+            worker_ttl
+            if worker_ttl is not None
+            else config.parse_timedelta(config.get("scheduler.worker-ttl")) or 0
+        )
+        self.idle_timeout = (
+            idle_timeout
+            if idle_timeout is not None
+            else config.parse_timedelta(config.get("scheduler.idle-timeout"))
+        )
+        self.idle_since: float | None = time()
+        self._last_worker_seen: dict[str, float] = {}
+
+        handlers = {
+            "register-worker": self.add_worker,
+            "register-client": self.add_client,
+            "heartbeat_worker": self.heartbeat_worker,
+            "gather": self.gather,
+            "scatter": self.scatter,
+            "cancel": self.stimulus_cancel,
+            "retry": self.stimulus_retry,
+            "who_has": self.get_who_has,
+            "has_what": self.get_has_what,
+            "ncores": self.get_ncores,
+            "nbytes": self.get_nbytes,
+            "processing": self.get_processing,
+            "identity": self.identity,
+            "broadcast": self.broadcast,
+            "run_function": self.run_function_on_scheduler,
+            "restart": self.restart,
+            "get_logs": self.get_events_handler,
+            "log_event": self.log_event_handler,
+            "events": self.get_events_handler,
+            "missing_workers": self.get_missing_workers,
+            "retire_workers": self.retire_workers,
+            "remove_worker": self.remove_worker_handler,
+        }
+        stream_handlers = {
+            # from workers
+            "task-finished": self.handle_task_finished,
+            "task-erred": self.handle_task_erred,
+            "release-worker-data": self.handle_release_data,
+            "add-keys": self.handle_add_keys,
+            "long-running": self.handle_long_running,
+            "reschedule": self.handle_reschedule,
+            "missing-data": self.handle_missing_data,
+            "request-refresh-who-has": self.handle_request_refresh_who_has,
+            "log-event": self.handle_worker_log_event,
+            "worker-status-change": self.handle_worker_status_change,
+            # from clients
+            "update-graph": self.update_graph,
+            "client-desires-keys": self.handle_client_desires_keys,
+            "client-releases-keys": self.handle_client_releases_keys,
+            "heartbeat-client": self.handle_heartbeat_client,
+            "close-client": self.handle_close_client,
+        }
+        super().__init__(
+            handlers=handlers, stream_handlers=stream_handlers, **server_kwargs
+        )
+        self.extensions: dict[str, Any] = {}
+        for name, ext_cls in (extensions or {}).items():
+            self.extensions[name] = ext_cls(self)
+        self.state.extensions = self.extensions
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start_unsafe(self) -> "Scheduler":
+        addr = self._listen_addr or "tcp://127.0.0.1:0"
+        await self.listen(addr)
+        if self.worker_ttl:
+            self.periodic_callbacks["worker-ttl"] = PeriodicCallback(
+                self.check_worker_ttl, max(self.worker_ttl / 4, 0.25)
+            )
+        if self.idle_timeout:
+            self.periodic_callbacks["idle-timeout"] = PeriodicCallback(
+                self.check_idle, max(self.idle_timeout / 4, 0.25)
+            )
+        self.start_periodic_callbacks()
+        logger.info("scheduler listening at %s", self.address)
+        return self
+
+    async def close(self, timeout: float | None = None) -> None:
+        if self.status in (Status.closed, Status.closing):
+            await self.finished()
+            return
+        self.status = Status.closing
+        logger.info("closing scheduler %s", self.id)
+        for pc in self.periodic_callbacks.values():
+            pc.stop()
+        for ext in self.extensions.values():
+            close = getattr(ext, "close", None)
+            if close is not None:
+                try:
+                    res = close()
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception("extension close failed")
+        # tell workers to shut down
+        for addr, bs in list(self.stream_comms.items()):
+            try:
+                bs.send({"op": "close-worker"})
+            except CommClosedError:
+                pass
+            await bs.close(timeout=0.5)
+        for client, bs in list(self.client_comms.items()):
+            await bs.close(timeout=0.5)
+        await super().close()
+
+    # ------------------------------------------------------------ messaging
+
+    def send_all(self, client_msgs: dict, worker_msgs: dict) -> None:
+        """Route state-machine output onto the batched streams
+        (reference scheduler.py:6067)."""
+        for client, msgs in client_msgs.items():
+            bs = self.client_comms.get(client)
+            if bs is None:
+                continue
+            try:
+                bs.send(*[self._wrap_payload(m) for m in msgs])
+            except CommClosedError:
+                logger.info("lost connection to client %s", client)
+        for worker, msgs in worker_msgs.items():
+            bs = self.stream_comms.get(worker)
+            if bs is None:
+                continue
+            try:
+                bs.send(*[self._wrap_payload(m) for m in msgs])
+            except CommClosedError:
+                logger.info("lost connection to worker %s", worker)
+                self._ongoing_background_tasks.call_soon(
+                    self.remove_worker, worker, "comm-closed"
+                )
+
+    @staticmethod
+    def _wrap_payload(msg: dict) -> dict:
+        """Ensure non-msgpackable payloads cross the wire pickled."""
+        for field in ("exception", "traceback"):
+            v = msg.get(field)
+            if v is not None and not isinstance(v, (Serialize, str, bytes)):
+                msg = dict(msg)
+                msg[field] = Serialize(v)
+        return msg
+
+    def report(self, msg: dict, *, client: str | None = None) -> None:
+        """Send a message to one or all clients."""
+        if client is not None:
+            targets = [client] if client in self.client_comms else []
+        else:
+            targets = list(self.client_comms)
+        for c in targets:
+            try:
+                self.client_comms[c].send(self._wrap_payload(msg))
+            except CommClosedError:
+                pass
+
+    # -------------------------------------------------------------- workers
+
+    async def add_worker(self, comm: Comm, **kwargs: Any) -> Any:
+        """Worker registration handshake; the comm becomes the dual stream
+        (reference scheduler.py:4308)."""
+        address = kwargs["address"]
+        if address in self.state.workers:
+            await comm.write({"status": "error", "message": "worker already exists"})
+            return Status.dont_reply
+        ws = self.state.add_worker_state(
+            address,
+            nthreads=kwargs.get("nthreads", 1),
+            memory_limit=kwargs.get("memory_limit", 0),
+            name=kwargs.get("name"),
+            resources=kwargs.get("resources"),
+            server_id=kwargs.get("server_id"),
+        )
+        self._last_worker_seen[address] = time()
+        logger.info("register worker %s (%d threads)", address, ws.nthreads)
+
+        bs = BatchedSend(interval=0.002)
+        bs.start(comm)
+        self.stream_comms[address] = bs
+        await comm.write({"status": "OK", "time": time()})
+
+        stimulus_id = seq_name("add-worker")
+        recs = self.state.bulk_schedule_unrunnable_after_adding_worker(ws)
+        client_msgs, worker_msgs = self.state.transitions(recs, stimulus_id)
+        recs2 = self.state.stimulus_queue_slots_maybe_opened(stimulus_id)
+        cm2, wm2 = self.state.transitions(recs2, stimulus_id)
+        for d, extra in ((client_msgs, cm2), (worker_msgs, wm2)):
+            for k, v in extra.items():
+                d.setdefault(k, []).extend(v)
+        self.send_all(client_msgs, worker_msgs)
+        for ext in self.extensions.values():
+            cb = getattr(ext, "add_worker", None)
+            if cb is not None:
+                try:
+                    cb(self, address)
+                except Exception:
+                    logger.exception("extension add_worker failed")
+
+        try:
+            await self.handle_stream(comm, extra={"worker": address})
+        finally:
+            await self.remove_worker(address, "stream-closed")
+        return Status.dont_reply
+
+    async def remove_worker(self, address: str, reason: str = "", *,
+                            safe: bool = False) -> None:
+        """Worker left or died: reschedule its work (reference scheduler.py:5180)."""
+        if address not in self.state.workers:
+            return
+        logger.info("remove worker %s (%s)", address, reason)
+        stimulus_id = seq_name("remove-worker")
+        bs = self.stream_comms.pop(address, None)
+        if bs is not None:
+            bs.abort()
+        self._last_worker_seen.pop(address, None)
+        client_msgs, worker_msgs = self.state.remove_worker_state(
+            address, stimulus_id=stimulus_id, safe=safe
+        )
+        self.send_all(client_msgs, worker_msgs)
+        for ext in self.extensions.values():
+            cb = getattr(ext, "remove_worker", None)
+            if cb is not None:
+                try:
+                    cb(self, address)
+                except Exception:
+                    logger.exception("extension remove_worker failed")
+
+    async def remove_worker_handler(self, address: str = "", reason: str = "") -> str:
+        await self.remove_worker(address, reason or "rpc")
+        return "OK"
+
+    async def heartbeat_worker(
+        self, address: str = "", now: float = 0.0, metrics: dict | None = None,
+        **kwargs: Any,
+    ) -> dict:
+        ws = self.state.workers.get(address)
+        if ws is None:
+            return {"status": "missing"}
+        self._last_worker_seen[address] = time()
+        ws.last_seen = time()
+        if metrics:
+            ws.metrics = metrics
+        return {"status": "OK", "time": time(),
+                "heartbeat-interval": self.heartbeat_interval()}
+
+    def heartbeat_interval(self) -> float:
+        """Scale heartbeat cadence with cluster size (reference scheduler.py:8749)."""
+        n = len(self.state.workers)
+        if n <= 10:
+            return 0.5
+        if n < 50:
+            return 1.0
+        return n / 200 + 1
+
+    async def check_worker_ttl(self) -> None:
+        """Evict workers that stopped heartbeating (reference scheduler.py:8312)."""
+        now = time()
+        for address, seen in list(self._last_worker_seen.items()):
+            if now - seen > self.worker_ttl:
+                logger.warning("worker %s missed its ttl; removing", address)
+                await self.remove_worker(address, "ttl-expired")
+
+    async def check_idle(self) -> None:
+        s = self.state
+        busy = any(ws.processing for ws in s.workers.values()) or s.queued or s.unrunnable
+        if busy or s.clients:
+            self.idle_since = None
+            return
+        if self.idle_since is None:
+            self.idle_since = time()
+        elif self.idle_timeout and time() - self.idle_since > self.idle_timeout:
+            logger.info("scheduler idle for %.0fs; closing", time() - self.idle_since)
+            self._ongoing_background_tasks.call_soon(self.close)
+
+    # -------------------------------------------------------------- clients
+
+    async def add_client(self, comm: Comm, client: str = "", **kwargs: Any) -> Any:
+        """Client registration; the comm becomes the report stream
+        (reference scheduler.py:5550)."""
+        logger.info("register client %s", client)
+        self.state.add_client_state(client)
+        bs = BatchedSend(interval=0.002)
+        bs.start(comm)
+        self.client_comms[client] = bs
+        await comm.write({"status": "OK", "time": time(),
+                          "id": self.id, "type": type(self).__name__})
+        try:
+            await self.handle_stream(comm, extra={"client": client})
+        finally:
+            self.client_comms.pop(client, None)
+            stimulus_id = seq_name("remove-client")
+            client_msgs, worker_msgs = self.state.remove_client_state(
+                client, stimulus_id
+            )
+            self.send_all(client_msgs, worker_msgs)
+            logger.info("remove client %s", client)
+        return Status.dont_reply
+
+    def handle_heartbeat_client(self, client: str = "", **kwargs: Any) -> None:
+        pass
+
+    async def handle_close_client(self, client: str = "", **kwargs: Any) -> None:
+        bs = self.client_comms.get(client)
+        if bs is not None:
+            bs.send({"op": "stream-closed"})
+
+    # ----------------------------------------------------------- graph intake
+
+    async def update_graph(
+        self,
+        client: str = "",
+        tasks: Any = None,
+        dependencies: dict | None = None,
+        keys: Iterable[Key] = (),
+        priorities: dict | None = None,
+        user_priority: Any = 0,
+        annotations_by_key: dict | None = None,
+        retries: Any = None,
+        actors: Any = False,
+        stimulus_id: str | None = None,
+        **kwargs: Any,
+    ) -> None:
+        """Receive a task graph from a client (reference scheduler.py:4662)."""
+        stimulus_id = stimulus_id or seq_name("update-graph")
+        try:
+            tasks = unwrap(tasks) or {}
+            deps = {
+                k: set(v) for k, v in (dependencies or {}).items()
+            }
+            self.generation += 1
+            client_msgs, worker_msgs = self.state.update_graph_core(
+                tasks,
+                deps,
+                list(keys),
+                client=client,
+                priorities=priorities,
+                user_priority=user_priority,
+                generation=self.generation,
+                annotations_by_key=annotations_by_key,
+                retries=retries,
+                actors=actors,
+                stimulus_id=stimulus_id,
+            )
+            self.send_all(client_msgs, worker_msgs)
+        except Exception as e:
+            logger.exception("update_graph failed")
+            for key in keys:
+                self.report(
+                    {
+                        "op": "task-erred",
+                        "key": key,
+                        "exception": Serialize(e),
+                        "traceback": None,
+                    },
+                    client=client,
+                )
+
+    def handle_client_desires_keys(self, keys: Iterable[Key] = (),
+                                   client: str = "", **kw: Any) -> None:
+        self.state.client_desires_keys(keys, client)
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            if ts is not None and ts.state == "memory":
+                self.report({"op": "key-in-memory", "key": key}, client=client)
+
+    def handle_client_releases_keys(self, keys: Iterable[Key] = (),
+                                    client: str = "", **kw: Any) -> None:
+        stimulus_id = seq_name("client-releases-keys")
+        client_msgs, worker_msgs = self.state.client_releases_keys(
+            keys, client, stimulus_id
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    # ----------------------------------------------------- worker stream ops
+
+    def handle_task_finished(self, key: Key = "", worker: str = "",
+                             stimulus_id: str = "", **kwargs: Any) -> None:
+        kwargs.pop("op", None)
+        client_msgs, worker_msgs = self.state.stimulus_task_finished(
+            key, worker, stimulus_id or seq_name("task-finished"), **kwargs
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_task_erred(self, key: Key = "", worker: str = "",
+                          stimulus_id: str = "", exception: Any = None,
+                          traceback: Any = None, **kwargs: Any) -> None:
+        kwargs.pop("op", None)
+        client_msgs, worker_msgs = self.state.stimulus_task_erred(
+            key,
+            worker,
+            stimulus_id or seq_name("task-erred"),
+            exception=unwrap(exception),
+            traceback=unwrap(traceback),
+            **kwargs,
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_release_data(self, key: Key = "", worker: str = "",
+                            stimulus_id: str = "", **kwargs: Any) -> None:
+        ts = self.state.tasks.get(key)
+        ws = self.state.workers.get(worker)
+        if ts is None or ws is None:
+            return
+        if ws in ts.who_has:
+            self.state.remove_replica(ts, ws)
+        if not ts.who_has:
+            client_msgs, worker_msgs = self.state.transitions(
+                {key: "released"}, stimulus_id or seq_name("release-data")
+            )
+            self.send_all(client_msgs, worker_msgs)
+
+    def handle_add_keys(self, keys: Iterable[Key] = (), worker: str = "",
+                        stimulus_id: str = "", **kwargs: Any) -> None:
+        """Worker acquired replicas out-of-band (reference scheduler.py:5855)."""
+        ws = self.state.workers.get(worker)
+        if ws is None:
+            return
+        redundant = []
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            if ts is not None and ts.state == "memory":
+                self.state.add_replica(ts, ws)
+            else:
+                redundant.append(key)
+        if redundant:
+            self.send_all({}, {worker: [{
+                "op": "remove-replicas", "keys": redundant,
+                "stimulus_id": stimulus_id or seq_name("add-keys"),
+            }]})
+
+    def handle_long_running(self, key: Key = "", worker: str = "",
+                            compute_duration: float = 0.0,
+                            stimulus_id: str = "", **kwargs: Any) -> None:
+        """Task seceded from its thread slot (reference scheduler.py:5906)."""
+        ts = self.state.tasks.get(key)
+        if ts is None or ts.processing_on is None:
+            return
+        ws = ts.processing_on
+        if ws.address != worker:
+            return
+        occ = ws.processing.get(ts)
+        if occ is not None:
+            self.state._adjust_occupancy(ws, -occ)
+            ws.processing[ts] = 0.0
+        ws.long_running.add(ts)
+        self.state.check_idle_saturated(ws)
+
+    def handle_reschedule(self, key: Key = "", worker: str = "",
+                          stimulus_id: str = "", **kwargs: Any) -> None:
+        ts = self.state.tasks.get(key)
+        if ts is None or ts.processing_on is None:
+            return
+        if ts.processing_on.address != worker:
+            return
+        client_msgs, worker_msgs = self.state.transitions(
+            {key: "released"}, stimulus_id or seq_name("reschedule")
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_missing_data(self, key: Key = "", errant_worker: str = "",
+                            stimulus_id: str = "", **kwargs: Any) -> None:
+        """A peer did not have data it was supposed to (reference :5869)."""
+        ts = self.state.tasks.get(key)
+        ws = self.state.workers.get(errant_worker)
+        if ts is None:
+            return
+        if ws is not None and ws in ts.who_has:
+            self.state.remove_replica(ts, ws)
+        if not ts.who_has:
+            client_msgs, worker_msgs = self.state.transitions(
+                {key: "released"}, stimulus_id or seq_name("missing-data")
+            )
+            self.send_all(client_msgs, worker_msgs)
+
+    def handle_request_refresh_who_has(self, keys: Iterable[Key] = (),
+                                       worker: str = "",
+                                       stimulus_id: str = "", **kw: Any) -> None:
+        who_has = {}
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            who_has[key] = (
+                [ws.address for ws in ts.who_has] if ts is not None else []
+            )
+        self.send_all({}, {worker: [{
+            "op": "refresh-who-has", "who_has": who_has,
+            "stimulus_id": stimulus_id or seq_name("refresh-who-has"),
+        }]})
+
+    def handle_worker_log_event(self, topic: Any = None, msg: Any = None,
+                                worker: str = "", **kw: Any) -> None:
+        self.state.log_event(topic or "all", {"worker": worker, "msg": msg})
+
+    def handle_worker_status_change(self, status: str = "", worker: str = "",
+                                    stimulus_id: str = "", **kw: Any) -> None:
+        ws = self.state.workers.get(worker)
+        if ws is None:
+            return
+        ws.status = status
+        if status == "paused":
+            self.state.running.discard(ws)
+            self.state.idle.pop(ws.address, None)
+            self.state.idle_task_count.discard(ws)
+        elif status == "running":
+            self.state.running.add(ws)
+            self.state.check_idle_saturated(ws)
+            stimulus_id = stimulus_id or seq_name("worker-unpaused")
+            recs = self.state.stimulus_queue_slots_maybe_opened(stimulus_id)
+            client_msgs, worker_msgs = self.state.transitions(recs, stimulus_id)
+            self.send_all(client_msgs, worker_msgs)
+
+    # ------------------------------------------------------------- data ops
+
+    async def gather(self, keys: Iterable[Key] = (), **kwargs: Any) -> dict:
+        """Collect data from workers for a client (reference scheduler.py:6150)."""
+        keys = list(keys)
+        who_has = {}
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            who_has[key] = [ws.address for ws in ts.who_has] if ts else []
+        data, missing, failed = await gather_from_workers(who_has, rpc=self.rpc)
+        if missing:
+            logger.warning("gather couldn't find %s", sorted(missing))
+            return {
+                "status": "error",
+                "keys": sorted(missing),
+                "workers": failed,
+            }
+        return {"status": "OK", "data": {k: Serialize(v) for k, v in data.items()}}
+
+    async def scatter(
+        self,
+        data: Any = None,
+        client: str | None = None,
+        workers: list[str] | None = None,
+        broadcast: bool = False,
+        timeout: float = 2.0,
+        **kwargs: Any,
+    ) -> list[Key]:
+        """Place client data onto workers (reference scheduler.py:6103)."""
+        data = {k: unwrap(v) for k, v in (unwrap(data) or {}).items()}
+        start = time()
+        while not self.state.running:
+            if time() - start > timeout:
+                raise TimeoutError("no workers available for scatter")
+            await asyncio.sleep(0.01)
+        if workers:
+            targets = [w for w in workers if w in self.state.workers]
+        else:
+            targets = sorted(ws.address for ws in self.state.running)
+        who_has = await scatter_to_workers(targets, data, rpc=self.rpc)
+        from distributed_tpu.utils.sizeof import sizeof
+
+        for key, holders in who_has.items():
+            ts = self.state.tasks.get(key)
+            if ts is None:
+                ts = self.state.new_task(key, None, "released")
+            if ts.state not in ("released", "memory"):
+                # key collides with a task mid-flight: leave the scheduler
+                # state machine alone (the worker copy is surplus data)
+                logger.warning(
+                    "scatter ignoring key %r already in state %r", key, ts.state
+                )
+                continue
+            ts.state = "memory"
+            if ts.priority is None:
+                ts.priority = (0, 0, 0)
+            self.state.update_nbytes(ts, sizeof(data[key]))
+            for addr in holders:
+                ws = self.state.workers.get(addr)
+                if ws is not None:
+                    self.state.add_replica(ts, ws)
+        if broadcast:
+            await self.replicate(keys=list(who_has), n=len(targets) if broadcast is True else broadcast)
+        if client is not None:
+            self.state.client_desires_keys(list(who_has), client)
+        return list(who_has)
+
+    async def replicate(self, keys: Iterable[Key] = (), n: int | None = None,
+                        workers: list[str] | None = None, **kwargs: Any) -> None:
+        """Copy keys onto additional workers (reference scheduler.py:6854)."""
+        candidates = [
+            self.state.workers[w] for w in (workers or [])
+            if w in self.state.workers
+        ] or list(self.state.running)
+        if not candidates:
+            return
+        n = n or len(candidates)
+        stimulus_id = seq_name("replicate")
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            if ts is None or not ts.who_has:
+                continue
+            need = min(n, len(candidates)) - len(ts.who_has)
+            if need <= 0:
+                continue
+            holders = [ws.address for ws in ts.who_has]
+            targets = [ws for ws in candidates if ws not in ts.who_has][:need]
+            for ws in targets:
+                self.send_all({}, {ws.address: [{
+                    "op": "acquire-replicas",
+                    "who_has": {key: holders},
+                    "nbytes": {key: ts.nbytes},
+                    "stimulus_id": stimulus_id,
+                }]})
+
+    # ---------------------------------------------------------- control ops
+
+    async def stimulus_cancel(self, keys: Iterable[Key] = (), client: str = "",
+                              force: bool = False, **kwargs: Any) -> None:
+        """Client cancels futures (reference scheduler.py:5161)."""
+        stimulus_id = seq_name("cancel")
+        cancelled = []
+        for key in keys:
+            ts = self.state.tasks.get(key)
+            if ts is None:
+                continue
+            cancelled.append(key)
+            self.report(
+                {"op": "cancelled-keys", "keys": [key]}, client=client
+            )
+        client_msgs, worker_msgs = self.state.client_releases_keys(
+            cancelled, client, stimulus_id
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    async def stimulus_retry(self, keys: Iterable[Key] = (),
+                             client: str | None = None, **kwargs: Any) -> list:
+        client_msgs, worker_msgs = self.state.stimulus_retry(
+            keys, seq_name("retry")
+        )
+        self.send_all(client_msgs, worker_msgs)
+        return list(keys)
+
+    async def restart(self, **kwargs: Any) -> str:
+        """Forget all tasks; clear cluster state (reference scheduler.py:6193)."""
+        stimulus_id = seq_name("restart")
+        for cs in list(self.state.clients.values()):
+            if cs.client_key in self.client_comms:
+                self.client_comms[cs.client_key].send(
+                    {"op": "restart", "stimulus_id": stimulus_id}
+                )
+        for addr in list(self.state.workers):
+            self.send_all({}, {addr: [{"op": "free-keys",
+                                       "keys": list(self.state.tasks),
+                                       "stimulus_id": stimulus_id}]})
+        self.state._clear_task_state()
+        return "OK"
+
+    async def broadcast(self, msg: dict | None = None,
+                        workers: list[str] | None = None,
+                        hosts: list[str] | None = None,
+                        nanny: bool = False, **kwargs: Any) -> dict:
+        """Send an RPC to many workers, gather replies (reference :6331)."""
+        msg = dict(unwrap(msg) or {})
+        targets = workers if workers is not None else list(self.state.workers)
+        op = msg.pop("op")
+
+        async def one(addr: str):
+            try:
+                return addr, await getattr(self.rpc(addr), op)(**msg)
+            except Exception as e:
+                return addr, error_message(e)
+
+        results = await asyncio.gather(*(one(a) for a in targets))
+        return dict(results)
+
+    async def run_function_on_scheduler(self, function: Any = None,
+                                        args: Any = None,
+                                        kwargs: Any = None, **kw: Any) -> Any:
+        fn = unwrap(function)
+        a = unwrap(args) or ()
+        k = unwrap(kwargs) or {}
+        try:
+            import inspect
+
+            if "dtpu_scheduler" in inspect.signature(fn).parameters:
+                k["dtpu_scheduler"] = self
+            result = fn(*a, **k)
+            if asyncio.iscoroutine(result):
+                result = await result
+            return {"status": "OK", "result": Serialize(result)}
+        except Exception as e:
+            return error_message(e)
+
+    async def retire_workers(self, workers: list[str] | None = None,
+                             n: int | None = None, **kwargs: Any) -> list[str]:
+        """Gracefully drain workers: replicate unique data away first
+        (reference scheduler.py:7144, simplified)."""
+        s = self.state
+        if workers is None:
+            if n is None:
+                return []
+            by_occ = sorted(s.workers.values(), key=lambda ws: ws.occupancy)
+            workers = [ws.address for ws in by_occ[:n]]
+        retired = []
+        for addr in workers:
+            ws = s.workers.get(addr)
+            if ws is None:
+                continue
+            # move unique replicas to surviving workers
+            survivors = [w for w in s.running if w.address != addr]
+            if survivors:
+                for ts in list(ws.has_what):
+                    if len(ts.who_has) == 1:
+                        target = min(survivors, key=lambda w: w.nbytes)
+                        resp = await self.rpc(target.address).gather(
+                            who_has={ts.key: [addr]}
+                        )
+                        if resp.get("status") == "OK":
+                            s.add_replica(ts, target)
+            await self.remove_worker(addr, "retired", safe=True)
+            retired.append(addr)
+            # tell the worker process to shut down
+            try:
+                await self.rpc(addr).terminate()
+            except (CommClosedError, OSError):
+                pass
+        return retired
+
+    # ------------------------------------------------------------- queries
+
+    async def get_who_has(self, keys: Iterable[Key] | None = None) -> dict:
+        s = self.state
+        if keys is None:
+            keys = list(s.tasks)
+        return {
+            k: [ws.address for ws in s.tasks[k].who_has] if k in s.tasks else []
+            for k in keys
+        }
+
+    async def get_has_what(self, workers: Iterable[str] | None = None) -> dict:
+        s = self.state
+        if workers is None:
+            workers = list(s.workers)
+        return {
+            w: [ts.key for ts in s.workers[w].has_what] if w in s.workers else []
+            for w in workers
+        }
+
+    async def get_ncores(self, workers: Iterable[str] | None = None) -> dict:
+        s = self.state
+        if workers is None:
+            workers = list(s.workers)
+        return {w: s.workers[w].nthreads for w in workers if w in s.workers}
+
+    async def get_nbytes(self, keys: Iterable[Key] | None = None,
+                         summary: bool = True) -> dict:
+        s = self.state
+        if keys is not None:
+            return {k: s.tasks[k].nbytes for k in keys if k in s.tasks}
+        return {k: ts.nbytes for k, ts in s.tasks.items() if ts.nbytes >= 0}
+
+    async def get_processing(self, workers: Iterable[str] | None = None) -> dict:
+        s = self.state
+        if workers is None:
+            workers = list(s.workers)
+        return {
+            w: [ts.key for ts in s.workers[w].processing]
+            for w in workers if w in s.workers
+        }
+
+    async def get_missing_workers(self) -> list:
+        return []
+
+    async def log_event_handler(self, topic: Any = None, msg: Any = None) -> None:
+        self.state.log_event(topic or "all", msg)
+
+    async def get_events_handler(self, topic: str | None = None) -> Any:
+        if topic is not None:
+            return list(self.state.events.get(topic, ()))
+        return {t: list(evs) for t, evs in self.state.events.items()}
+
+    async def identity(self) -> dict:
+        return {
+            "type": type(self).__name__,
+            "id": self.id,
+            "address": self.address,
+            "workers": {
+                addr: {
+                    "name": ws.name,
+                    "nthreads": ws.nthreads,
+                    "memory_limit": ws.memory_limit,
+                }
+                for addr, ws in self.state.workers.items()
+            },
+        }
+
+    def __repr__(self) -> str:
+        try:
+            addr = self.address
+        except ValueError:
+            addr = "not-listening"
+        return (
+            f"<Scheduler {addr!r} workers={len(self.state.workers)} "
+            f"tasks={len(self.state.tasks)}>"
+        )

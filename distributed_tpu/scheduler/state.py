@@ -493,6 +493,20 @@ class SchedulerState:
         ):
             coll.clear()
         self.queued.clear()
+        # per-worker mirrors reference the cleared TaskStates: reset them
+        # too or memory/occupancy accounting is permanently wrong
+        for ws in self.workers.values():
+            ws.has_what.clear()
+            ws.processing.clear()
+            ws.long_running.clear()
+            ws.executing.clear()
+            ws.actors.clear()
+            ws.nbytes = 0
+            ws.occupancy = 0.0
+            ws._network_occ = 0
+            ws.used_resources = dict.fromkeys(ws.used_resources, 0)
+            self.check_idle_saturated(ws)
+        self._total_occupancy = 0.0
 
     # ------------------------------------------------- transition engine
 
@@ -901,14 +915,15 @@ class SchedulerState:
         ts.exception = None
         ts.exception_blame = None
         ts.traceback = None
+        # build free-keys messages before clearing the erred_on record
+        w_msg = {"op": "free-keys", "keys": [key], "stimulus_id": stimulus_id}
+        worker_msgs = {addr: [w_msg] for addr in ts.erred_on if addr in self.workers}
         ts.erred_on.clear()
         recommendations: dict[Key, str] = {}
         client_msgs: dict = {}
         for dts in ts.dependents:
             if dts.state == "erred":
                 recommendations[dts.key] = "waiting"
-        w_msg = {"op": "free-keys", "keys": [key], "stimulus_id": stimulus_id}
-        worker_msgs = {addr: [w_msg] for addr in ts.erred_on if addr in self.workers}
         report_msg = {"op": "task-retried", "key": key}
         for cs in ts.who_wants:
             client_msgs.setdefault(cs.client_key, []).append(report_msg)
@@ -1151,7 +1166,14 @@ class SchedulerState:
         return {ws.address: [self._task_to_msg(ts, stimulus_id)]}
 
     def _task_to_msg(self, ts: TaskState, stimulus_id: str) -> dict:
-        """Build the compute-task message (reference scheduler.py:3421)."""
+        """Build the compute-task message (reference scheduler.py:3421).
+
+        ``run_spec`` is wrapped in ``ToPickle`` so it crosses tcp comms
+        pickled (the reference does the same, scheduler.py:3438); over
+        inproc the wrapper arrives intact and the worker unwraps it.
+        """
+        from distributed_tpu.protocol.serialize import ToPickle
+
         assert ts.priority is not None
         return {
             "op": "compute-task",
@@ -1162,7 +1184,7 @@ class SchedulerState:
                 dts.key: [wws.address for wws in dts.who_has] for dts in ts.dependencies
             },
             "nbytes": {dts.key: dts.nbytes for dts in ts.dependencies},
-            "run_spec": ts.run_spec,
+            "run_spec": ToPickle(ts.run_spec) if ts.run_spec is not None else None,
             "duration": self.get_task_duration(ts),
             "resource_restrictions": ts.resource_restrictions,
             "actor": ts.actor,
@@ -1699,12 +1721,14 @@ class SchedulerState:
         return self.transitions(recommendations, stimulus_id)
 
     def remove_client_state(self, client: str, stimulus_id: str) -> tuple[dict, dict]:
-        cs = self.clients.pop(client, None)
+        cs = self.clients.get(client)
         if cs is None:
             return {}, {}
-        return self.client_releases_keys(
+        out = self.client_releases_keys(
             [ts.key for ts in cs.wants_what], client, stimulus_id
         )
+        del self.clients[client]
+        return out
 
     # ------------------------------------------------------ graph intake
 
@@ -1734,9 +1758,14 @@ class SchedulerState:
         if priorities is None:
             from distributed_tpu.graph.order import order as order_fn
 
-            priorities = {
-                k: (r,) for k, r in order_fn(dependencies).items()
+            # deps on keys submitted in earlier graphs are already-known
+            # tasks: exclude them from static ordering of this batch
+            known = set(dependencies)
+            pruned = {
+                k: {d for d in deps if d in known}
+                for k, deps in dependencies.items()
             }
+            priorities = {k: (r,) for k, r in order_fn(pruned).items()}
 
         touched: list[TaskState] = []
         for key, spec in tasks.items():

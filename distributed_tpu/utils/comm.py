@@ -1,0 +1,126 @@
+"""Bulk data-movement helpers (reference utils_comm.py).
+
+``gather_from_workers`` pulls keys from many workers with per-source
+failover; ``scatter_to_workers`` pushes data round-robin; ``retry_operation``
+wraps flaky comm calls with configured backoff.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from collections import defaultdict
+from typing import Any, Callable
+
+from distributed_tpu import config
+from distributed_tpu.exceptions import CommClosedError
+
+logger = logging.getLogger("distributed_tpu.utils.comm")
+
+
+from distributed_tpu.protocol.serialize import unwrap as _unwrap
+
+
+async def gather_from_workers(
+    who_has: dict[str, list[str]],
+    rpc: Callable,
+) -> tuple[dict[str, Any], set[str], list[str]]:
+    """Fetch ``{key: [workers]}`` from the cluster (reference utils_comm.py:56).
+
+    Returns ``(data, missing_keys, failed_workers)``.  Tries alternative
+    holders for a key when a worker is unreachable or no longer has it.
+    """
+    data: dict[str, Any] = {}
+    missing: set[str] = set()
+    failed_workers: set[str] = set()
+    remaining: dict[str, list[str]] = {
+        k: list(ws) for k, ws in who_has.items() if ws
+    }
+    missing.update(k for k, ws in who_has.items() if not ws)
+
+    while remaining:
+        # group this round's fetches by worker
+        by_worker: dict[str, list[str]] = defaultdict(list)
+        for key, holders in list(remaining.items()):
+            holders = [w for w in holders if w not in failed_workers]
+            if not holders:
+                missing.add(key)
+                del remaining[key]
+                continue
+            by_worker[random.choice(holders)].append(key)
+        if not by_worker:
+            break
+
+        async def fetch(worker: str, keys: list[str]):
+            try:
+                resp = await rpc(worker).get_data(keys=keys, who=None)
+            except (OSError, CommClosedError, asyncio.TimeoutError):
+                return worker, None
+            return worker, resp
+
+        results = await asyncio.gather(
+            *(fetch(w, ks) for w, ks in by_worker.items())
+        )
+        for worker, resp in results:
+            keys = by_worker[worker]
+            if resp is None:
+                failed_workers.add(worker)
+                for k in keys:
+                    remaining[k] = [w for w in remaining.get(k, []) if w != worker]
+                continue
+            got = resp.get("data", {})
+            for k in keys:
+                if k in got:
+                    data[k] = _unwrap(got[k])
+                    remaining.pop(k, None)
+                else:
+                    # holder no longer has it; drop this holder and retry
+                    remaining[k] = [w for w in remaining.get(k, []) if w != worker]
+                    if not remaining[k]:
+                        missing.add(k)
+                        remaining.pop(k, None)
+    return data, missing, sorted(failed_workers)
+
+
+async def scatter_to_workers(
+    workers: list[str],
+    data: dict[str, Any],
+    rpc: Callable,
+) -> dict[str, list[str]]:
+    """Round-robin ``data`` onto ``workers``; returns ``{key: [worker]}``."""
+    from distributed_tpu.protocol.serialize import Serialize
+
+    assert workers
+    placements: dict[str, dict[str, Any]] = defaultdict(dict)
+    for i, (key, value) in enumerate(data.items()):
+        placements[workers[i % len(workers)]][key] = Serialize(value)
+
+    async def push(worker: str, chunk: dict):
+        await rpc(worker).update_data(data=chunk, report=False)
+        return worker, list(chunk)
+
+    results = await asyncio.gather(*(push(w, c) for w, c in placements.items()))
+    who_has: dict[str, list[str]] = {}
+    for worker, keys in results:
+        for k in keys:
+            who_has.setdefault(k, []).append(worker)
+    return who_has
+
+
+async def retry_operation(coro_factory: Callable, *args: Any, **kwargs: Any) -> Any:
+    """Retry a flaky comm operation per the ``comm.retry`` config
+    (reference utils_comm.py:380)."""
+    count = config.get("comm.retry.count", 0)
+    delay_min = config.parse_timedelta(config.get("comm.retry.delay.min", "1s"))
+    delay_max = config.parse_timedelta(config.get("comm.retry.delay.max", "20s"))
+    for attempt in range(count + 1):
+        try:
+            return await coro_factory(*args, **kwargs)
+        except (OSError, CommClosedError, asyncio.TimeoutError):
+            if attempt == count:
+                raise
+            delay = min(delay_min * (2**attempt), delay_max)
+            delay *= 1 + random.random() * 0.2
+            logger.info("retrying after comm failure (attempt %d)", attempt + 1)
+            await asyncio.sleep(delay)

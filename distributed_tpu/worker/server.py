@@ -1,0 +1,541 @@
+"""Worker server: the async shell around the sans-IO state machine.
+
+Equivalent of the reference's ``Worker`` (worker.py:264) +
+``BaseWorker`` (worker_state_machine.py:3589): a ``Server`` with RPC
+handlers (get_data, run, ...) and stream handlers that translate scheduler
+ops into state-machine events; instructions coming back out of
+``WorkerState.handle_stimulus`` are turned into asyncio tasks
+(Execute -> thread pool, GatherDep -> peer RPC) whose outcomes are fed
+back in as new events — the only bridge between the pure state machine
+and IO.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+from distributed_tpu import config
+from distributed_tpu.comm.core import Comm, connect
+from distributed_tpu.exceptions import CommClosedError, Reschedule, WorkerClosedError
+from distributed_tpu.graph.spec import Key
+from distributed_tpu.protocol.serialize import Serialize, unwrap
+from distributed_tpu.rpc.batched import BatchedSend
+from distributed_tpu.rpc.core import PeriodicCallback, Server, Status, error_message
+from distributed_tpu.utils.misc import (
+    format_exception,
+    seq_name,
+    time,
+    truncate_exception,
+)
+from distributed_tpu.utils.sizeof import sizeof
+from distributed_tpu.worker.state_machine import (
+    AcquireReplicasEvent,
+    ComputeTaskEvent,
+    Execute,
+    ExecuteFailureEvent,
+    ExecuteSuccessEvent,
+    FindMissingEvent,
+    FreeKeysEvent,
+    GatherDep,
+    GatherDepBusyEvent,
+    GatherDepFailureEvent,
+    GatherDepNetworkFailureEvent,
+    GatherDepSuccessEvent,
+    Instruction,
+    PauseEvent,
+    RefreshWhoHasEvent,
+    RemoveReplicasEvent,
+    RescheduleEvent,
+    RetryBusyWorkerEvent,
+    RetryBusyWorkerLater,
+    SendMessageToScheduler,
+    StateMachineEvent,
+    StealRequestEvent,
+    UnpauseEvent,
+    UpdateDataEvent,
+    WorkerState,
+)
+
+logger = logging.getLogger("distributed_tpu.worker")
+
+
+class Worker(Server):
+    """Executes tasks, stores results, serves peers (reference worker.py:264)."""
+
+    def __init__(
+        self,
+        scheduler_addr: str,
+        *,
+        nthreads: int | None = None,
+        name: object = None,
+        memory_limit: int = 0,
+        resources: dict[str, float] | None = None,
+        validate: bool | None = None,
+        heartbeat_interval: float | None = None,
+        listen_addr: str | None = None,
+        **server_kwargs: Any,
+    ):
+        self.scheduler_addr = scheduler_addr
+        self.nthreads = nthreads or 1
+        self.memory_limit = memory_limit
+        self._listen_addr = listen_addr
+        self.state = WorkerState(
+            nthreads=self.nthreads,
+            resources=resources,
+            validate=validate,
+        )
+        self.data = self.state.data
+        self.executor = ThreadPoolExecutor(
+            self.nthreads, thread_name_prefix="dtpu-worker-exec"
+        )
+        self.batched_stream = BatchedSend(interval=0.002)
+        self.scheduler_comm: Comm | None = None
+        self.heartbeat_interval = (
+            heartbeat_interval if heartbeat_interval is not None else 1.0
+        )
+        self.plugins: dict[str, Any] = {}
+        self._async_instructions: set[asyncio.Task] = set()
+
+        handlers = {
+            "get_data": self.get_data,
+            "gather": self.gather,
+            "run": self.run_function,
+            "update_data": self.update_data_handler,
+            "free_keys": self.handle_free_keys_rpc,
+            "terminate": self.close_rpc,
+            "plugin_add": self.plugin_add,
+            "plugin_remove": self.plugin_remove,
+        }
+        stream_handlers = {
+            "compute-task": self._stream_compute_task,
+            "free-keys": self._stream_free_keys,
+            "remove-replicas": self._stream_remove_replicas,
+            "acquire-replicas": self._stream_acquire_replicas,
+            "steal-request": self._stream_steal_request,
+            "refresh-who-has": self._stream_refresh_who_has,
+            "worker-status-change": self._stream_status_change,
+            "close-worker": self._stream_close,
+        }
+        super().__init__(
+            handlers=handlers, stream_handlers=stream_handlers, name=name,
+            **server_kwargs,
+        )
+        self.name = name if name is not None else self.id
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start_unsafe(self) -> "Worker":
+        addr = self._listen_addr
+        if addr is None:
+            addr = "tcp://127.0.0.1:0"
+        await self.listen(addr)
+        self.state.address = self.address
+        await self._register_with_scheduler()
+        if self.heartbeat_interval > 0:
+            self.periodic_callbacks["heartbeat"] = PeriodicCallback(
+                self.heartbeat, self.heartbeat_interval
+            )
+        self.periodic_callbacks["find-missing"] = PeriodicCallback(
+            self.find_missing, 1.0
+        )
+        self.start_periodic_callbacks()
+        return self
+
+    async def _register_with_scheduler(self) -> None:
+        """Handshake + dual stream with the scheduler (reference worker.py:1164)."""
+        comm = await connect(self.scheduler_addr)
+        await comm.write(
+            {
+                "op": "register-worker",
+                "address": self.address,
+                "nthreads": self.nthreads,
+                "name": self.name,
+                "memory_limit": self.memory_limit,
+                "resources": self.state.total_resources,
+                "server_id": self.id,
+                "reply": False,
+            }
+        )
+        resp = await comm.read()
+        if resp.get("status") != "OK":
+            raise ValueError(f"scheduler rejected worker: {resp!r}")
+        self.scheduler_comm = comm
+        self.batched_stream.start(comm)
+        self._ongoing_background_tasks.call_soon(self.handle_scheduler, comm)
+        logger.info("%s registered with scheduler %s", self.address, self.scheduler_addr)
+
+    async def handle_scheduler(self, comm: Comm) -> None:
+        """Read scheduler->worker stream ops until the comm dies."""
+        try:
+            await self.handle_stream(comm)
+        finally:
+            if self.status not in (Status.closing, Status.closed, Status.failed):
+                logger.info("connection to scheduler lost; closing %s", self.address)
+                await self.close()
+
+    async def heartbeat(self) -> None:
+        if self.batched_stream.closed():
+            return
+        try:
+            resp = await self.rpc(self.scheduler_addr).heartbeat_worker(
+                address=self.address,
+                now=time(),
+                metrics=self.metrics(),
+            )
+            if resp.get("status") == "missing":
+                # scheduler forgot us (e.g. after its restart): re-register
+                await self.close()
+        except (CommClosedError, OSError):
+            pass
+
+    def metrics(self) -> dict:
+        return {
+            "executing": len(self.state.executing),
+            "ready": len(self.state.ready),
+            "in_flight": len(self.state.in_flight_tasks),
+            "in_memory": len(self.data),
+            "memory": self.state.nbytes_in_memory,
+        }
+
+    async def find_missing(self) -> None:
+        if any(ts.state == "missing" for ts in self.state.tasks.values()):
+            self.handle_stimulus(FindMissingEvent(stimulus_id=seq_name("find-missing")))
+
+    async def close(self, timeout: float | None = None, *, report: bool = True) -> None:
+        if self.status in (Status.closed, Status.closing):
+            await self.finished()
+            return
+        self.status = Status.closing
+        logger.info("closing worker %s", self.address)
+        for pc in self.periodic_callbacks.values():
+            pc.stop()
+        for plugin in list(self.plugins.values()):
+            teardown = getattr(plugin, "teardown", None)
+            if teardown is not None:
+                try:
+                    res = teardown(self)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception("plugin teardown failed")
+        for task in list(self._async_instructions):
+            task.cancel()
+        if self._async_instructions:
+            await asyncio.gather(*self._async_instructions, return_exceptions=True)
+        await self.batched_stream.close()
+        if self.scheduler_comm is not None:
+            await self.scheduler_comm.close()
+        self.executor.shutdown(wait=False)
+        await super().close()
+
+    async def close_rpc(self, reason: str = "") -> str:
+        self._ongoing_background_tasks.call_soon(self.close)
+        return "OK"
+
+    async def _stream_close(self, **kwargs: Any) -> None:
+        self._ongoing_background_tasks.call_soon(self.close)
+
+    # --------------------------------------------------------- RPC handlers
+
+    async def get_data(
+        self, keys: tuple = (), who: str | None = None, **kwargs: Any
+    ) -> dict:
+        """Serve locally-held task data to a peer (reference worker.py:1722)."""
+        data = {}
+        for k in keys:
+            if k in self.data:
+                data[k] = Serialize(self.data[k])
+        return {
+            "status": "OK",
+            "data": data,
+            "nbytes": {k: self.state.tasks[k].nbytes if k in self.state.tasks
+                       else sizeof(self.data[k]) for k in data},
+        }
+
+    async def gather(self, who_has: dict[Key, list[str]] | None = None) -> dict:
+        """Pull keys from peers into local memory (reference worker.py:1274)."""
+        who_has = who_has or {}
+        from distributed_tpu.utils.comm import gather_from_workers
+
+        data, missing, _ = await gather_from_workers(who_has, rpc=self.rpc)
+        self.handle_stimulus(
+            UpdateDataEvent(stimulus_id=seq_name("gather"), data=data)
+        )
+        if missing:
+            return {"status": "partial-fail", "keys": list(missing)}
+        return {"status": "OK"}
+
+    async def run_function(
+        self, function: Any = None, args: Any = None, kwargs: Any = None,
+        wait: bool = True,
+    ) -> Any:
+        """Run an arbitrary function on this worker (reference worker.py run)."""
+        fn = unwrap(function)
+        args = unwrap(args) or ()
+        kw = unwrap(kwargs) or {}
+        try:
+            import inspect
+
+            if "dtpu_worker" in inspect.signature(fn).parameters:
+                kw["dtpu_worker"] = self
+            result = fn(*args, **kw)
+            if asyncio.iscoroutine(result):
+                if wait:
+                    result = await result
+                else:
+                    self._ongoing_background_tasks.call_soon(lambda: result)
+                    result = None
+            return {"status": "OK", "result": Serialize(result)}
+        except Exception as e:
+            return error_message(e)
+
+    async def update_data_handler(self, data: Any = None, report: bool = True) -> dict:
+        """Receive scattered data (reference worker.py update_data)."""
+        data = {k: unwrap(v) for k, v in (unwrap(data) or {}).items()}
+        self.handle_stimulus(
+            UpdateDataEvent(
+                stimulus_id=seq_name("update-data"), data=data, report=report
+            )
+        )
+        return {"status": "OK", "nbytes": {k: sizeof(v) for k, v in data.items()}}
+
+    async def handle_free_keys_rpc(self, keys: tuple = (), stimulus_id: str = "") -> str:
+        self.handle_stimulus(
+            FreeKeysEvent(stimulus_id=stimulus_id or seq_name("free-keys"),
+                          keys=tuple(keys))
+        )
+        return "OK"
+
+    async def plugin_add(self, plugin: Any = None, name: str | None = None) -> dict:
+        plugin = unwrap(plugin)
+        name = name or getattr(plugin, "name", None) or f"plugin-{len(self.plugins)}"
+        self.plugins[name] = plugin
+        setup = getattr(plugin, "setup", None)
+        if setup is not None:
+            try:
+                res = setup(worker=self)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception as e:
+                return error_message(e)
+        return {"status": "OK"}
+
+    async def plugin_remove(self, name: str = "") -> dict:
+        plugin = self.plugins.pop(name, None)
+        if plugin is not None:
+            teardown = getattr(plugin, "teardown", None)
+            if teardown is not None:
+                try:
+                    res = teardown(self)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception as e:
+                    return error_message(e)
+        return {"status": "OK"}
+
+    # ------------------------------------------------------ stream handlers
+
+    def _stream_compute_task(self, **msg: Any) -> None:
+        msg.pop("op", None)
+        msg["run_spec"] = unwrap(msg.get("run_spec"))
+        msg["priority"] = tuple(msg.get("priority") or ())
+        fields = ComputeTaskEvent.__dataclass_fields__
+        msg = {
+            k: v for k, v in msg.items()
+            if k in fields and (v is not None or k in ("run_spec", "span_id"))
+        }
+        self.handle_stimulus(ComputeTaskEvent(**msg))
+
+    def _stream_free_keys(self, keys: tuple = (), stimulus_id: str = "") -> None:
+        self.handle_stimulus(FreeKeysEvent(stimulus_id=stimulus_id, keys=tuple(keys)))
+
+    def _stream_remove_replicas(self, keys: tuple = (), stimulus_id: str = "") -> None:
+        self.handle_stimulus(
+            RemoveReplicasEvent(stimulus_id=stimulus_id, keys=tuple(keys))
+        )
+
+    def _stream_acquire_replicas(
+        self, who_has: dict | None = None, nbytes: dict | None = None,
+        stimulus_id: str = "",
+    ) -> None:
+        self.handle_stimulus(
+            AcquireReplicasEvent(
+                stimulus_id=stimulus_id, who_has=who_has or {}, nbytes=nbytes or {}
+            )
+        )
+
+    def _stream_steal_request(self, key: Key = "", stimulus_id: str = "") -> None:
+        self.handle_stimulus(StealRequestEvent(stimulus_id=stimulus_id, key=key))
+
+    def _stream_refresh_who_has(self, who_has: dict | None = None,
+                                stimulus_id: str = "") -> None:
+        self.handle_stimulus(
+            RefreshWhoHasEvent(
+                stimulus_id=stimulus_id or seq_name("refresh"), who_has=who_has or {}
+            )
+        )
+
+    def _stream_status_change(self, status: str = "", stimulus_id: str = "") -> None:
+        if status == "paused":
+            self.handle_stimulus(PauseEvent(stimulus_id=stimulus_id))
+        elif status == "running":
+            self.handle_stimulus(UnpauseEvent(stimulus_id=stimulus_id))
+
+    # ------------------------------------------------- instruction execution
+
+    def handle_stimulus(self, *events: StateMachineEvent) -> None:
+        """Feed events into the state machine, act on the instructions
+        (reference worker.py:1931)."""
+        if self.status in (Status.closed, Status.failed):
+            return
+        instructions = self.state.handle_stimulus(*events)
+        self._handle_instructions(instructions)
+
+    def _handle_instructions(self, instructions: list[Instruction]) -> None:
+        for inst in instructions:
+            if isinstance(inst, SendMessageToScheduler):
+                msg = inst.to_dict()
+                if msg.get("op") == "task-erred":
+                    # exceptions cross the wire pickled
+                    msg["exception"] = Serialize(msg["exception"])
+                    msg["traceback"] = None
+                try:
+                    self.batched_stream.send(msg)
+                except CommClosedError:
+                    pass
+            elif isinstance(inst, Execute):
+                self._start_async_instruction(
+                    self._execute(inst.key, inst.stimulus_id)
+                )
+            elif isinstance(inst, GatherDep):
+                self._start_async_instruction(
+                    self._gather_dep(inst.worker, inst.to_gather,
+                                     inst.total_nbytes, inst.stimulus_id)
+                )
+            elif isinstance(inst, RetryBusyWorkerLater):
+                self._ongoing_background_tasks.call_later(
+                    0.15, self._retry_busy_worker, inst.worker
+                )
+            else:  # pragma: no cover - future instruction types
+                raise TypeError(f"unknown instruction {inst!r}")
+
+    def _start_async_instruction(self, coro: Any) -> None:
+        """Run an instruction coroutine; feed its resulting event back in
+        (reference wsm.py:3603)."""
+        task = asyncio.create_task(coro)
+        self._async_instructions.add(task)
+
+        def _done(task: asyncio.Task) -> None:
+            self._async_instructions.discard(task)
+            if task.cancelled():
+                return
+            exc = task.exception()
+            if exc is not None:
+                logger.exception("async instruction failed", exc_info=exc)
+                return
+            event = task.result()
+            if event is not None:
+                self.handle_stimulus(event)
+
+        task.add_done_callback(_done)
+
+    async def _retry_busy_worker(self, worker: str) -> None:
+        self.handle_stimulus(
+            RetryBusyWorkerEvent(stimulus_id=seq_name("retry-busy"), worker=worker)
+        )
+
+    # ------------------------------------------------------------- execute
+
+    async def _execute(self, key: Key, stimulus_id: str) -> StateMachineEvent | None:
+        """Run one task (reference worker.py:2210)."""
+        ts = self.state.tasks.get(key)
+        if ts is None or ts.state not in ("executing", "long-running", "cancelled"):
+            return None
+        run_spec = ts.run_spec
+        start = time()
+        try:
+            if hasattr(run_spec, "substitute"):
+                fn, args, kwargs = run_spec.substitute(self.data)
+                if asyncio.iscoroutinefunction(fn):
+                    value = await fn(*args, **kwargs)
+                else:
+                    value = await asyncio.get_running_loop().run_in_executor(
+                        self.executor, lambda: fn(*args, **kwargs)
+                    )
+            else:
+                value = unwrap(run_spec)  # literal data baked into the graph
+            stop = time()
+            return ExecuteSuccessEvent(
+                stimulus_id=stimulus_id,
+                key=key,
+                value=value,
+                start=start,
+                stop=stop,
+                nbytes=sizeof(value),
+                type=type(value).__name__,
+            )
+        except Reschedule:
+            return RescheduleEvent(stimulus_id=stimulus_id, key=key)
+        except BaseException as e:  # noqa: B036 - user code may raise anything
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            stop = time()
+            e2 = truncate_exception(e)
+            return ExecuteFailureEvent(
+                stimulus_id=stimulus_id,
+                key=key,
+                exception=e2,
+                traceback=None,
+                exception_text=repr(e2),
+                traceback_text=format_exception(e),
+                start=start,
+                stop=stop,
+            )
+
+    # ---------------------------------------------------------- gather_dep
+
+    async def _gather_dep(
+        self, worker: str, to_gather: tuple, total_nbytes: int, stimulus_id: str
+    ) -> StateMachineEvent:
+        """Fetch a batch of keys from one peer (reference worker.py:2030)."""
+        try:
+            resp = await self.rpc(worker).get_data(
+                keys=list(to_gather), who=self.address
+            )
+        except (CommClosedError, OSError, asyncio.TimeoutError):
+            self.state._gather_finished(worker)
+            return GatherDepNetworkFailureEvent(
+                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather)
+            )
+        except Exception as e:
+            self.state._gather_finished(worker)
+            return GatherDepFailureEvent(
+                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather),
+                exception=e, traceback=None,
+            )
+        self.state._gather_finished(worker)
+        if resp.get("status") == "busy":
+            return GatherDepBusyEvent(
+                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather)
+            )
+        data = {k: unwrap(v) for k, v in resp.get("data", {}).items()}
+        return GatherDepSuccessEvent(
+            stimulus_id=stimulus_id,
+            worker=worker,
+            data=data,
+            total_nbytes=sum(sizeof(v) for v in data.values()),
+        )
+
+    def __repr__(self) -> str:
+        try:
+            addr = self.address
+        except ValueError:
+            addr = "not-listening"
+        return (
+            f"<Worker {addr!r} status={self.status.name} "
+            f"executing={len(self.state.executing)} stored={len(self.data)}>"
+        )

@@ -255,9 +255,16 @@ class StealRequestEvent(StateMachineEvent):
 
 @dataclass(frozen=True)
 class UpdateDataEvent(StateMachineEvent):
-    """Client scattered data directly to this worker."""
+    """Client scattered data directly to this worker.
+
+    ``report=False`` suppresses the add-keys message — used by scatter,
+    where the scheduler registers the replicas itself and an early
+    add-keys would race with that registration (reference worker.py
+    update_data(report=False)).
+    """
 
     data: dict[Key, Any] = field(default_factory=dict)
+    report: bool = True
 
 
 @dataclass(frozen=True)
@@ -752,16 +759,25 @@ class WorkerState:
 
     def _handle_update_data(self, ev: UpdateDataEvent) -> tuple[Recs, Instructions]:
         recs: Recs = {}
+        instr: Instructions = []
         for key, value in ev.data.items():
             ts = self.tasks.get(key)
             if ts is None:
                 ts = self.tasks[key] = WTaskState(key)
                 ts.priority = (0,)
             self.data[key] = value
-            recs[ts] = "memory"
-        return recs, [
-            AddKeysMsg(stimulus_id=ev.stimulus_id, keys=tuple(ev.data))
-        ]
+            if ts.state in ("flight", "executing", "long-running", "cancelled",
+                            "resumed"):
+                # route through the transition table so in_flight/executing
+                # bookkeeping is exited properly
+                recs[ts] = "memory"
+            else:
+                r, i = self._put_memory(
+                    ts, ev.stimulus_id, send_add_keys=ev.report
+                )
+                recs.update(r)
+                instr += i
+        return recs, instr
 
     def _handle_pause(self, ev: PauseEvent) -> tuple[Recs, Instructions]:
         self.running = False
