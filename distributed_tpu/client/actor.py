@@ -1,0 +1,88 @@
+"""Actors: stateful tasks pinned to one worker (reference actor.py).
+
+``client.submit(MyClass, actor=True)`` runs the constructor once on a
+worker; the instance stays in ``worker.state.actors`` and the task's
+"value" is an ``ActorPlaceholder``.  Resolving the future yields an
+``Actor`` proxy whose method calls are direct client->worker RPCs
+(``actor_execute``, reference worker.py:2159) bypassing the scheduler,
+and whose plain attributes are fetched via ``actor_attribute``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from distributed_tpu.protocol.serialize import Serialize, unwrap
+from distributed_tpu.rpc.core import rpc as _rpc
+
+
+class ActorPlaceholder:
+    """The stored 'value' of an actor task: (class, key, worker address)."""
+
+    __slots__ = ("cls", "key", "worker")
+
+    def __init__(self, cls: type, key: str, worker: str):
+        self.cls = cls
+        self.key = key
+        self.worker = worker
+
+    def __reduce__(self):
+        return (ActorPlaceholder, (self.cls, self.key, self.worker))
+
+    def __repr__(self) -> str:
+        return f"<ActorPlaceholder {self.cls.__name__} {self.key} on {self.worker}>"
+
+
+class Actor:
+    """Client-side proxy to a remote actor instance (reference actor.py:22)."""
+
+    def __init__(self, cls: type, worker: str, key: str, io: Any = None):
+        self._cls = cls
+        self._worker = worker
+        self._key = key
+        self._io = io if io is not None else _rpc(worker)
+
+    @classmethod
+    def from_placeholder(cls, ph: ActorPlaceholder, io: Any = None) -> "Actor":
+        return cls(ph.cls, ph.worker, ph.key, io=io)
+
+    def __repr__(self) -> str:
+        return f"<Actor: {self._cls.__name__}, key={self._key}>"
+
+    def __dir__(self):
+        return sorted(set(dir(type(self))) | {
+            a for a in dir(self._cls) if not a.startswith("_")
+        })
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self._cls, name, None)
+        if callable(attr):
+            async def call(*args: Any, **kwargs: Any):
+                resp = await self._io.actor_execute(
+                    actor=self._key,
+                    function=name,
+                    args=Serialize(args),
+                    kwargs=Serialize(kwargs),
+                )
+                if resp.get("status") == "error":
+                    from distributed_tpu.rpc.core import raise_remote_error
+
+                    raise_remote_error(resp)
+                return unwrap(resp["result"])
+
+            return call
+
+        async def get_attribute():
+            resp = await self._io.actor_attribute(
+                actor=self._key, attribute=name
+            )
+            if resp.get("status") == "error":
+                from distributed_tpu.rpc.core import raise_remote_error
+
+                raise_remote_error(resp)
+            return unwrap(resp["result"])
+
+        return get_attribute()

@@ -81,10 +81,14 @@ class Future:
 
     @property
     def status(self) -> str:
+        if self.client is None:
+            return "unbound"
         st = self.client.futures.get(self.key)
         return st.status if st is not None else "cancelled"
 
     def done(self) -> bool:
+        if self.client is None:
+            return False
         st = self.client.futures.get(self.key)
         return st is not None and st.event.is_set()
 
@@ -126,6 +130,16 @@ class Future:
     def __repr__(self) -> str:
         return f"<Future: {self.status}, key: {self.key}>"
 
+    def __getstate__(self) -> str:
+        # futures pickle as their key alone (reference client.py:430);
+        # the receiving side rebinds to its own client (_rebind_futures)
+        return self.key
+
+    def __setstate__(self, key: str) -> None:
+        self.key = key
+        self.client = None  # unbound stub until rebound
+        self._cleared = True
+
     def __await__(self):
         return self.result().__await__()
 
@@ -159,6 +173,8 @@ class Client:
         self.asynchronous = asynchronous
         self._timeout = timeout
         self._handle_report_task: asyncio.Task | None = None
+        self._pubsub_subs: dict[str, list] = {}
+        self._worker_rpcs: dict[str, Any] = {}
         self._generation = 0
         self._loop_runner: LoopRunner | None = None
         if not asynchronous:
@@ -225,6 +241,9 @@ class Client:
             await self.scheduler_comm.close()
         if self.scheduler is not None:
             await self.scheduler.close_rpc()
+        for r in self._worker_rpcs.values():
+            await r.close_rpc()
+        self._worker_rpcs.clear()
         for st in self.futures.values():
             if not st.event.is_set():
                 st.cancel()
@@ -254,6 +273,9 @@ class Client:
                             st = self.futures.get(key)
                             if st is not None:
                                 st.cancel()
+                    elif op == "pubsub-msg":
+                        for sub in self._pubsub_subs.get(msg.get("name"), ()):
+                            sub._put(msg.get("msg"))
                     elif op in ("stream-closed", "close", "restart"):
                         if op == "restart":
                             for st in self.futures.values():
@@ -453,7 +475,23 @@ class Client:
         if st.status == "cancelled":
             raise asyncio.CancelledError(future.key)
         data = await self._gather_keys([future.key])
-        return data[future.key]
+        return self._maybe_actor(data[future.key])
+
+    def _maybe_actor(self, value: Any) -> Any:
+        from distributed_tpu.client.actor import Actor, ActorPlaceholder
+
+        if isinstance(value, ActorPlaceholder):
+            return Actor.from_placeholder(value, io=self._worker_rpc(value.worker))
+        return value
+
+    def _worker_rpc(self, address: str):
+        """Cached direct rpc to a worker (actor calls, direct gather)."""
+        r = self._worker_rpcs.get(address)
+        if r is None:
+            from distributed_tpu.rpc.core import rpc as _rpc
+
+            r = self._worker_rpcs[address] = _rpc(address)
+        return r
 
     async def gather(self, futures: Any, errors: str = "raise") -> Any:
         """Wait for and download many futures (reference client.py:2317);
@@ -482,6 +520,17 @@ class Client:
         data = await self._gather_keys(list(dict.fromkeys(keys)))
         return _substitute_futures(futures, data, errors)
 
+    def _ensure_tracked(self, key: Key) -> "FutureState":
+        """Track a key learned out-of-band (queue/variable/dataset): register
+        interest with the scheduler, which reports its current state."""
+        st = self.futures.get(key)
+        if st is None:
+            st = self.futures[key] = FutureState()
+            self.batched_stream.send(
+                {"op": "client-desires-keys", "keys": [key], "client": self.id}
+            )
+        return st
+
     async def _gather_keys(self, keys: list[Key]) -> dict[Key, Any]:
         if not keys:
             return {}
@@ -490,7 +539,10 @@ class Client:
         for attempt in range(attempts):
             resp = await self.scheduler.gather(keys=keys)
             if resp.get("status") == "OK":
-                return {k: unwrap(v) for k, v in resp["data"].items()}
+                return {
+                    k: self._maybe_actor(unwrap(v))
+                    for k, v in resp["data"].items()
+                }
             missing = resp.get("keys", [])
             logger.warning("gather attempt %d missing %s", attempt, missing)
             await asyncio.sleep(0.1 * (attempt + 1))
@@ -594,6 +646,38 @@ class Client:
         for st in self.futures.values():
             st.cancel()
 
+    async def publish_dataset(self, name: str, data: Any,
+                              override: bool = False) -> None:
+        """Publish futures/data under a name that outlives this client
+        (reference client.py publish_dataset)."""
+        flat: list[Future] = []
+        _collect_futures(data, flat)
+        assert self.scheduler is not None
+        await self.scheduler.publish_put(
+            name=name,
+            keys=[f.key for f in flat],
+            data=Serialize(data),
+            override=override,
+        )
+
+    async def get_dataset(self, name: str) -> Any:
+        assert self.scheduler is not None
+        out = await self.scheduler.publish_get(name=name)
+        if out is None:
+            raise KeyError(f"dataset {name!r} not found")
+        data = unwrap(out["data"])
+        for key in out["keys"]:
+            self._ensure_tracked(key)
+        return _rebind_futures(data, self)
+
+    async def list_datasets(self) -> list[str]:
+        assert self.scheduler is not None
+        return await self.scheduler.publish_list()
+
+    async def unpublish_dataset(self, name: str) -> None:
+        assert self.scheduler is not None
+        await self.scheduler.publish_delete(name=name)
+
     async def who_has(self, futures: Iterable[Future] | None = None) -> dict:
         assert self.scheduler is not None
         keys = [f.key for f in futures] if futures is not None else None
@@ -630,6 +714,21 @@ def _futures_to_refs(obj: Any) -> Any:
         return [_futures_to_refs(o) for o in obj]
     if isinstance(obj, dict):
         return {k: _futures_to_refs(v) for k, v in obj.items()}
+    return obj
+
+
+def _rebind_futures(obj: Any, client: "Client") -> Any:
+    """Re-point unpickled Future objects at this client."""
+    if isinstance(obj, Future):
+        return Future(obj.key, client)
+    if isinstance(obj, tuple):
+        return tuple(_rebind_futures(o, client) for o in obj)
+    if isinstance(obj, list):
+        return [_rebind_futures(o, client) for o in obj]
+    if isinstance(obj, (set, frozenset)):
+        return type(obj)(_rebind_futures(o, client) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _rebind_futures(v, client) for k, v in obj.items()}
     return obj
 
 

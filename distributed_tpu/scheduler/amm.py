@@ -1,0 +1,267 @@
+"""Active Memory Manager: replica creation/destruction policies
+(reference active_memory_manager.py).
+
+Every ``interval`` (2 s default) the extension polls its policies; each
+policy yields ``Suggestion("replicate" | "drop", ts, candidates)``.  The
+extension picks the recipient with the lowest projected memory for
+replications and the holder with the highest for drops
+(reference active_memory_manager.py:233,290), then enacts the round via
+``acquire-replicas`` / ``remove-replicas`` worker messages.  The worker
+side already closes the loop: acquire -> gather -> add-keys registers the
+replica; remove -> release-worker-data unregisters it.
+
+``ReduceReplicas`` trims replicas beyond current waiter demand — the
+north-star bin-packing target (a vectorized variant lives in
+``distributed_tpu.ops``).  ``RetireWorker`` evacuates unique data for
+graceful retirement.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import TYPE_CHECKING, Any, Generator, Iterable
+
+from distributed_tpu import config
+from distributed_tpu.graph.spec import Key
+from distributed_tpu.rpc.core import PeriodicCallback
+from distributed_tpu.utils.misc import import_term, seq_name
+
+if TYPE_CHECKING:
+    from distributed_tpu.scheduler.server import Scheduler
+    from distributed_tpu.scheduler.state import TaskState, WorkerState
+
+logger = logging.getLogger("distributed_tpu.amm")
+
+Suggestion = tuple  # (op, ts, candidates | None)
+
+
+class ActiveMemoryManagerExtension:
+    """Scheduler extension (reference active_memory_manager.py:40)."""
+
+    def __init__(self, scheduler: "Scheduler", policies: Iterable | None = None,
+                 *, register: bool = True, start: bool | None = None,
+                 interval: float | None = None):
+        self.scheduler = scheduler
+        self.state = scheduler.state
+        self.policies: set[ActiveMemoryManagerPolicy] = set()
+        if policies is None:
+            policies = []
+            for spec in config.get("scheduler.active-memory-manager.policies"):
+                kwargs = dict(spec)
+                cls = import_term(kwargs.pop("class"))
+                policies.append(cls(**kwargs))
+        for policy in policies:
+            self.add_policy(policy)
+        if register:
+            scheduler.extensions["amm"] = self
+            scheduler.handlers["amm_run_once"] = self.run_once_handler
+            scheduler.handlers["amm_start"] = self.start_handler
+            scheduler.handlers["amm_stop"] = self.stop_handler
+        self.interval = (
+            interval
+            if interval is not None
+            else config.parse_timedelta(
+                config.get("scheduler.active-memory-manager.interval")
+            )
+        )
+        self._pc = PeriodicCallback(self._tick, self.interval)
+        if start is None:
+            start = config.get("scheduler.active-memory-manager.start")
+        if register and start:
+            scheduler.periodic_callbacks["amm"] = self._pc
+        # round-local bookkeeping (reference amm.py:58-66)
+        self.pending: dict = {}
+        self.workers_memory: dict = {}
+
+    def add_policy(self, policy: "ActiveMemoryManagerPolicy") -> None:
+        policy.manager = self
+        self.policies.add(policy)
+
+    async def close(self) -> None:
+        self._pc.stop()
+
+    async def run_once_handler(self) -> str:
+        self.run_once()
+        return "OK"
+
+    async def start_handler(self) -> str:
+        self._pc.start()
+        return "OK"
+
+    async def stop_handler(self) -> str:
+        self._pc.stop()
+        return "OK"
+
+    async def _tick(self) -> None:
+        self.run_once()
+
+    # ------------------------------------------------------------ one round
+
+    def run_once(self) -> None:
+        stimulus_id = seq_name("amm")
+        # projected memory per worker for this round: actual managed bytes
+        # plus/minus the round's own decisions (reference amm.py:~200)
+        self.workers_memory = {
+            ws: ws.nbytes for ws in self.state.workers.values()
+        }
+        try:
+            # pending[ts] -> (set of recipients, set of droppers)
+            self.pending = {}
+            for policy in list(self.policies):
+                try:
+                    gen = policy.run()
+                    while True:
+                        try:
+                            cmd = next(gen)
+                        except StopIteration:
+                            break
+                        self._handle_suggestion(cmd)
+                except Exception:
+                    logger.exception("AMM policy %r failed", policy)
+            drop_by_worker: defaultdict = defaultdict(list)
+            repl_by_worker: defaultdict = defaultdict(dict)
+            for ts, (recipients, droppers) in self.pending.items():
+                if recipients:
+                    holders = [wss.address for wss in ts.who_has]
+                    for ws in recipients:
+                        repl_by_worker[ws.address][ts.key] = holders
+                for ws in droppers:
+                    drop_by_worker[ws.address].append(ts.key)
+            worker_msgs: dict = {}
+            for addr, who_has in repl_by_worker.items():
+                worker_msgs.setdefault(addr, []).append({
+                    "op": "acquire-replicas",
+                    "who_has": who_has,
+                    "nbytes": {
+                        k: self.state.tasks[k].nbytes
+                        for k in who_has if k in self.state.tasks
+                    },
+                    "stimulus_id": stimulus_id,
+                })
+            for addr, keys in drop_by_worker.items():
+                worker_msgs.setdefault(addr, []).append({
+                    "op": "remove-replicas",
+                    "keys": keys,
+                    "stimulus_id": stimulus_id,
+                })
+            if worker_msgs:
+                self.scheduler.send_all({}, worker_msgs)
+        finally:
+            self.pending = {}
+            self.workers_memory = {}
+
+    def _handle_suggestion(self, cmd: Suggestion) -> None:
+        op, ts, candidates = cmd
+        recipients, droppers = self.pending.setdefault(ts, (set(), set()))
+        if op == "replicate":
+            ws = self._find_recipient(ts, candidates, recipients)
+            if ws is not None:
+                recipients.add(ws)
+                self.workers_memory[ws] = (
+                    self.workers_memory.get(ws, 0) + ts.get_nbytes()
+                )
+        elif op == "drop":
+            ws = self._find_dropper(ts, candidates, recipients, droppers)
+            if ws is not None:
+                droppers.add(ws)
+                self.workers_memory[ws] = max(
+                    0, self.workers_memory.get(ws, 0) - ts.get_nbytes()
+                )
+
+    def _find_recipient(self, ts: "TaskState", candidates, pending_repl
+                        ) -> "WorkerState | None":
+        """Lowest projected memory among eligible non-holders
+        (reference amm.py:233)."""
+        if ts.state != "memory":
+            return None
+        if candidates is None:
+            candidates = set(self.state.running)
+        else:
+            candidates = {ws for ws in candidates if ws in self.state.running}
+        candidates -= ts.who_has
+        candidates -= pending_repl
+        if not candidates:
+            return None
+        return min(candidates, key=lambda ws: self.workers_memory.get(ws, 0))
+
+    def _find_dropper(self, ts: "TaskState", candidates, pending_repl,
+                      pending_drop) -> "WorkerState | None":
+        """Highest projected memory among holders, never dropping the last
+        replica or one under active use (reference amm.py:290)."""
+        if len(ts.who_has) - len(pending_drop) < 2:
+            return None
+        if candidates is None:
+            candidates = set(ts.who_has)
+        else:
+            candidates = {ws for ws in candidates if ws in ts.who_has}
+        candidates -= pending_drop
+        candidates -= pending_repl
+        # don't drop from a worker about to run a dependent of ts
+        candidates -= {
+            waiter_ts.processing_on
+            for waiter_ts in ts.waiters
+            if waiter_ts.processing_on is not None
+        }
+        if not candidates:
+            return None
+        return max(candidates, key=lambda ws: self.workers_memory.get(ws, 0))
+
+
+class ActiveMemoryManagerPolicy:
+    """Base policy (reference active_memory_manager.py:431)."""
+
+    manager: ActiveMemoryManagerExtension
+
+    def run(self) -> Generator[Suggestion, None, None]:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+class ReduceReplicas(ActiveMemoryManagerPolicy):
+    """Drop replicas beyond current waiter demand
+    (reference active_memory_manager.py:527)."""
+
+    def run(self) -> Generator[Suggestion, None, None]:
+        state = self.manager.state
+        for ts in list(state.replicated_tasks):
+            desired = max(
+                1,
+                len({
+                    waiter.processing_on or waiter
+                    for waiter in ts.waiters
+                }) if ts.waiters else 1,
+            )
+            ndrop = len(ts.who_has) - desired
+            for _ in range(ndrop):
+                yield ("drop", ts, None)
+
+
+class RetireWorker(ActiveMemoryManagerPolicy):
+    """Evacuate all unique data from one worker before retirement
+    (reference active_memory_manager.py:571)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self.done = False
+
+    def run(self) -> Generator[Suggestion, None, None]:
+        state = self.manager.state
+        ws = state.workers.get(self.address)
+        if ws is None:
+            self.done = True
+            self.manager.policies.discard(self)
+            return
+        unique = [ts for ts in ws.has_what if len(ts.who_has) == 1]
+        if not unique:
+            self.done = True
+            self.manager.policies.discard(self)
+            return
+        others = [w for w in state.running if w is not ws]
+        for ts in unique:
+            yield ("replicate", ts, set(others) if others else None)
+
+    def __repr__(self) -> str:
+        return f"RetireWorker({self.address!r}, done={self.done})"

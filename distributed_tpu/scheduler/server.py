@@ -32,6 +32,19 @@ from distributed_tpu.utils.misc import seq_name, time
 logger = logging.getLogger("distributed_tpu.scheduler")
 
 
+def default_extensions() -> dict[str, Any]:
+    """The DEFAULT_EXTENSIONS table (reference scheduler.py:178-193)."""
+    from distributed_tpu.coordination.extensions import coordination_extensions
+    from distributed_tpu.scheduler.amm import ActiveMemoryManagerExtension
+    from distributed_tpu.scheduler.stealing import WorkStealing
+
+    return {
+        "stealing": WorkStealing,
+        "amm": ActiveMemoryManagerExtension,
+        **coordination_extensions(),
+    }
+
+
 class Scheduler(Server):
     """Central control plane (reference scheduler.py:3453)."""
 
@@ -119,7 +132,9 @@ class Scheduler(Server):
             handlers=handlers, stream_handlers=stream_handlers, **server_kwargs
         )
         self.extensions: dict[str, Any] = {}
-        for name, ext_cls in (extensions or {}).items():
+        if extensions is None:
+            extensions = default_extensions()
+        for name, ext_cls in extensions.items():
             self.extensions[name] = ext_cls(self)
         self.state.extensions = self.extensions
 
@@ -421,8 +436,20 @@ class Scheduler(Server):
         self.state.client_desires_keys(keys, client)
         for key in keys:
             ts = self.state.tasks.get(key)
-            if ts is not None and ts.state == "memory":
+            if ts is None:
+                continue
+            if ts.state == "memory":
                 self.report({"op": "key-in-memory", "key": key}, client=client)
+            elif ts.state == "erred":
+                self.report(
+                    {
+                        "op": "task-erred",
+                        "key": key,
+                        "exception": ts.exception,
+                        "traceback": ts.traceback,
+                    },
+                    client=client,
+                )
 
     def handle_client_releases_keys(self, keys: Iterable[Key] = (),
                                     client: str = "", **kw: Any) -> None:

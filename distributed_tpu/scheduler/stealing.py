@@ -1,0 +1,313 @@
+"""Work stealing: rebalance assigned-but-unstarted tasks (reference stealing.py).
+
+Every 100 ms, ``balance()`` moves queued work from saturated workers
+("victims") to idle ones ("thieves") when the move pays for itself:
+``occ_thief + cost <= occ_victim - cost/2`` (reference stealing.py:462-465).
+Tasks are bucketed into 15 cost levels by log2(transfer_time /
+compute_time) so cheap-to-move work is considered first.  Moves use an
+async confirm protocol with the victim worker — the task may already be
+executing there — fenced by stimulus ids (reference stealing.py:279,333).
+
+The inner (victim, level, thief) selection is a pure function over
+occupancy/cost arrays; ``distributed_tpu.ops.stealing`` provides the
+batched device variant used when the JAX co-processor is enabled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict, deque
+from math import log2
+from typing import TYPE_CHECKING, Any
+
+from distributed_tpu import config
+from distributed_tpu.exceptions import CommClosedError
+from distributed_tpu.graph.spec import Key
+from distributed_tpu.rpc.core import PeriodicCallback
+from distributed_tpu.utils.misc import seq_name, time
+
+if TYPE_CHECKING:
+    from distributed_tpu.scheduler.server import Scheduler
+    from distributed_tpu.scheduler.state import TaskState, WorkerState
+
+logger = logging.getLogger("distributed_tpu.stealing")
+
+# 15 steal levels; level i covers cost ratios around 2**(i-7)
+# (reference stealing.py:70: fast tasks in low levels move first)
+N_LEVELS = 15
+LATENCY = 0.1  # assumed steal round-trip (reference stealing.py:33-37)
+
+
+class InFlightInfo:
+    __slots__ = ("victim", "thief", "victim_duration", "thief_duration", "stimulus_id")
+
+    def __init__(self, victim, thief, victim_duration, thief_duration, stimulus_id):
+        self.victim = victim
+        self.thief = thief
+        self.victim_duration = victim_duration
+        self.thief_duration = thief_duration
+        self.stimulus_id = stimulus_id
+
+
+class WorkStealing:
+    """Scheduler extension (reference stealing.py:57)."""
+
+    def __init__(self, scheduler: "Scheduler"):
+        self.scheduler = scheduler
+        self.state = scheduler.state
+        # stealable[worker_address][level] -> set of TaskStates
+        self.stealable: dict[str, list[set]] = {}
+        self.key_stealable: dict[Key, tuple[str, int]] = {}
+        # in-flight steal requests awaiting worker confirmation
+        self.in_flight: dict[Key, InFlightInfo] = {}
+        # extra occupancy accounted to workers for unconfirmed moves
+        self.in_flight_occupancy: defaultdict[Any, float] = defaultdict(float)
+        self.in_flight_tasks: defaultdict[Any, int] = defaultdict(int)
+        self.metrics: dict[str, dict] = {
+            "request_count_total": defaultdict(int),
+            "request_cost_total": defaultdict(float),
+        }
+        self.count = 0
+        self.log: deque = deque(maxlen=100_000)
+        self._in_flight_event = asyncio.Event()
+        self._in_flight_event.set()
+
+        for ws in self.state.workers.values():
+            self.add_worker_state(ws)
+
+        self.state.plugins["stealing"] = self
+        scheduler.stream_handlers["steal-response"] = self.move_task_confirm
+        interval = config.parse_timedelta(
+            config.get("scheduler.work-stealing-interval")
+        )
+        self._pc = PeriodicCallback(self.balance, interval)
+        if config.get("scheduler.work-stealing"):
+            scheduler.periodic_callbacks["stealing"] = self._pc
+            if scheduler.status.name == "running":
+                self._pc.start()
+
+    async def close(self) -> None:
+        self._pc.stop()
+
+    # -------------------------------------------------------- plugin hooks
+
+    def add_worker_state(self, ws: "WorkerState") -> None:
+        self.stealable[ws.address] = [set() for _ in range(N_LEVELS)]
+
+    def add_worker(self, scheduler: Any, address: str) -> None:
+        ws = self.state.workers.get(address)
+        if ws is not None and address not in self.stealable:
+            self.add_worker_state(ws)
+
+    def remove_worker(self, scheduler: Any, address: str) -> None:
+        self.stealable.pop(address, None)
+
+    def transition(self, key: Key, start: str, finish: str, *args: Any,
+                   **kwargs: Any) -> None:
+        """Track stealability as tasks enter/leave processing."""
+        if finish == "processing":
+            ts = self.state.tasks[key]
+            self.put_key_in_stealable(ts)
+        elif start == "processing":
+            ts = self.state.tasks.get(key)
+            if ts is not None:
+                self.remove_key_from_stealable(ts)
+            info = self.in_flight.pop(key, None)
+            if info is not None:
+                self.in_flight_occupancy[info.thief] -= info.thief_duration
+                self.in_flight_occupancy[info.victim] += info.victim_duration
+                self.in_flight_tasks[info.victim] -= 1
+                if not self.in_flight:
+                    self.in_flight_occupancy.clear()
+                    self._in_flight_event.set()
+
+    # ----------------------------------------------------- stealable index
+
+    def steal_time_ratio(self, ts: "TaskState") -> tuple[float | None, int | None]:
+        """(cost, level); cost_multiplier None = never steal
+        (reference stealing.py:241)."""
+        if not ts.dependencies:
+            return 0, 0
+        if ts.worker_restrictions or ts.host_restrictions or ts.resource_restrictions:
+            return None, None
+        if ts.actor:
+            return None, None
+        compute_time = self.state.get_task_duration(ts)
+        if compute_time <= 0:
+            return None, None
+        nbytes = sum(dts.get_nbytes() for dts in ts.dependencies)
+        transfer_time = nbytes / self.state.bandwidth + LATENCY
+        cost = transfer_time / compute_time
+        level = int(min(N_LEVELS - 1, max(0, log2(cost + 1e-9) + 7)))
+        return cost, level
+
+    def put_key_in_stealable(self, ts: "TaskState") -> None:
+        if ts.processing_on is None:
+            return
+        cost, level = self.steal_time_ratio(ts)
+        if cost is None:
+            return
+        addr = ts.processing_on.address
+        levels = self.stealable.get(addr)
+        if levels is None:
+            return
+        levels[level].add(ts)
+        self.key_stealable[ts.key] = (addr, level)
+
+    def remove_key_from_stealable(self, ts: "TaskState") -> None:
+        loc = self.key_stealable.pop(ts.key, None)
+        if loc is None:
+            return
+        addr, level = loc
+        levels = self.stealable.get(addr)
+        if levels is not None:
+            levels[level].discard(ts)
+
+    # ------------------------------------------------------- move protocol
+
+    def move_task_request(self, ts: "TaskState", victim: "WorkerState",
+                          thief: "WorkerState") -> None:
+        """Ask the victim to relinquish ts (reference stealing.py:279)."""
+        key = ts.key
+        if key in self.in_flight:
+            return
+        stimulus_id = seq_name("steal")
+        victim_duration = victim.processing.get(ts, 0.0)
+        thief_duration = self.state.get_task_duration(
+            ts
+        ) + self.state.get_comm_cost(ts, thief)
+        self.remove_key_from_stealable(ts)
+        self.in_flight[key] = InFlightInfo(
+            victim, thief, victim_duration, thief_duration, stimulus_id
+        )
+        self.in_flight_occupancy[victim] -= victim_duration
+        self.in_flight_occupancy[thief] += thief_duration
+        self.in_flight_tasks[victim] += 1
+        self._in_flight_event.clear()
+        try:
+            self.scheduler.send_all({}, {victim.address: [{
+                "op": "steal-request", "key": key, "stimulus_id": stimulus_id,
+            }]})
+        except CommClosedError:
+            self.in_flight.pop(key, None)
+
+    async def move_task_confirm(self, key: Key = "", state: str | None = None,
+                                stimulus_id: str = "", worker: str = "",
+                                **kwargs: Any) -> None:
+        """The victim answered (reference stealing.py:333)."""
+        info = self.in_flight.pop(key, None)
+        if info is None or info.stimulus_id != stimulus_id:
+            return
+        victim, thief = info.victim, info.thief
+        self.in_flight_occupancy[thief] -= info.thief_duration
+        self.in_flight_occupancy[victim] += info.victim_duration
+        self.in_flight_tasks[victim] -= 1
+        if not self.in_flight:
+            self.in_flight_occupancy.clear()
+            self._in_flight_event.set()
+
+        ts = self.state.tasks.get(key)
+        if ts is None or ts.state != "processing" or ts.processing_on is not victim:
+            # the task finished / was released / moved meanwhile
+            return
+        if self.state.workers.get(victim.address) is not victim:
+            return
+        if state in ("ready", "waiting"):
+            # victim gave it up: reassign to thief
+            if self.state.workers.get(thief.address) is not thief or (
+                thief not in self.state.running
+            ):
+                # thief died meanwhile: reschedule from scratch
+                cm, wm = self.state.transitions(
+                    {key: "released"}, stimulus_id
+                )
+                self.scheduler.send_all(cm, wm)
+                return
+            self.state._exit_processing_common(ts)
+            ts.state = "waiting"  # transient; re-enter processing on thief
+            duration = info.thief_duration
+            victim.long_running.discard(ts)
+            ws_msgs = self.state._add_to_processing(ts, thief, stimulus_id)
+            self.count += 1
+            self.log.append(
+                ("confirm", key, victim.address, thief.address)
+            )
+            self.metrics["request_count_total"][victim.address] += 1
+            self.scheduler.send_all({}, ws_msgs)
+        else:
+            # already executing (or gone): leave it
+            self.log.append(("reject", key, state, victim.address))
+
+    # ------------------------------------------------------------ balance
+
+    def balance(self) -> None:
+        """One stealing cycle (reference stealing.py:402)."""
+        s = self.state
+        if not s.idle or len(s.workers) < 2:
+            return
+        idle_workers = [ws for ws in s.idle.values() if ws in s.running]
+        if not idle_workers:
+            return
+        if s.saturated:
+            victims = list(s.saturated)
+        else:
+            victims = sorted(
+                (ws for ws in s.workers.values()
+                 if ws.processing and ws not in s.idle.values()),
+                key=lambda ws: ws.occupancy / max(ws.nthreads, 1),
+                reverse=True,
+            )[:10]
+        start = time()
+        for victim in victims:
+            levels = self.stealable.get(victim.address)
+            if levels is None:
+                continue
+            for level, tasks in enumerate(levels):
+                if not tasks:
+                    continue
+                for ts in list(tasks):
+                    if not idle_workers:
+                        return
+                    if ts.key in self.in_flight or ts.processing_on is not victim:
+                        tasks.discard(ts)
+                        continue
+                    thief = self._get_thief(ts, idle_workers)
+                    if thief is None:
+                        continue
+                    occ_thief = self._combined_occupancy(thief)
+                    occ_victim = self._combined_occupancy(victim)
+                    comm_cost_thief = s.get_comm_cost(ts, thief)
+                    compute = s.get_task_duration(ts)
+                    if (
+                        occ_thief / max(thief.nthreads, 1)
+                        + comm_cost_thief + compute
+                        <= occ_victim / max(victim.nthreads, 1) - compute / 2
+                    ):
+                        self.move_task_request(ts, victim, thief)
+                        occ_thief = self._combined_occupancy(thief)
+                        if occ_thief / max(thief.nthreads, 1) > LATENCY:
+                            idle_workers = [
+                                w for w in idle_workers if w is not thief
+                            ]
+            if time() - start > 0.05:  # bound cycle time like the reference
+                break
+
+    def _combined_occupancy(self, ws: "WorkerState") -> float:
+        return ws.occupancy + self.in_flight_occupancy[ws]
+
+    def _get_thief(self, ts: "TaskState",
+                   idle_workers: list) -> "WorkerState | None":
+        valid = self.state.valid_workers(ts)
+        if valid is not None:
+            candidates = [ws for ws in idle_workers if ws in valid]
+        else:
+            candidates = idle_workers
+        if not candidates:
+            return None
+        return min(
+            candidates, key=lambda ws: self.state.worker_objective(ts, ws)
+        )
+
+    def story(self, *keys: Key) -> list:
+        return [t for t in self.log if any(k in t for k in keys)]

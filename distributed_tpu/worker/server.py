@@ -92,12 +92,18 @@ class Worker(Server):
         self.executor = ThreadPoolExecutor(
             self.nthreads, thread_name_prefix="dtpu-worker-exec"
         )
+        # actors serialize state access on their own single thread
+        # (reference worker.py "actor" executor)
+        self.actor_executor = ThreadPoolExecutor(
+            1, thread_name_prefix="dtpu-worker-actor"
+        )
         self.batched_stream = BatchedSend(interval=0.002)
         self.scheduler_comm: Comm | None = None
         self.heartbeat_interval = (
             heartbeat_interval if heartbeat_interval is not None else 1.0
         )
         self.plugins: dict[str, Any] = {}
+        self._pubsub_subs: dict[str, list] = {}
         self._async_instructions: set[asyncio.Task] = set()
 
         handlers = {
@@ -106,6 +112,8 @@ class Worker(Server):
             "run": self.run_function,
             "update_data": self.update_data_handler,
             "free_keys": self.handle_free_keys_rpc,
+            "actor_execute": self.actor_execute,
+            "actor_attribute": self.actor_attribute,
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
@@ -119,6 +127,7 @@ class Worker(Server):
             "refresh-who-has": self._stream_refresh_who_has,
             "worker-status-change": self._stream_status_change,
             "close-worker": self._stream_close,
+            "pubsub-msg": self._stream_pubsub_msg,
         }
         super().__init__(
             handlers=handlers, stream_handlers=stream_handlers, name=name,
@@ -230,6 +239,7 @@ class Worker(Server):
         if self.scheduler_comm is not None:
             await self.scheduler_comm.close()
         self.executor.shutdown(wait=False)
+        self.actor_executor.shutdown(wait=False)
         await super().close()
 
     async def close_rpc(self, reason: str = "") -> str:
@@ -310,6 +320,35 @@ class Worker(Server):
         )
         return "OK"
 
+    async def actor_execute(self, actor: str = "", function: str = "",
+                            args: Any = None, kwargs: Any = None) -> dict:
+        """Run a method on a resident actor (reference worker.py:2159)."""
+        instance = self.state.actors.get(actor)
+        if instance is None:
+            return error_message(ValueError(f"no actor {actor!r} on this worker"))
+        a = unwrap(args) or ()
+        kw = unwrap(kwargs) or {}
+        try:
+            fn = getattr(instance, function)
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(*a, **kw)
+            else:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    self.actor_executor, lambda: fn(*a, **kw)
+                )
+            return {"status": "OK", "result": Serialize(result)}
+        except Exception as e:
+            return error_message(e)
+
+    async def actor_attribute(self, actor: str = "", attribute: str = "") -> dict:
+        instance = self.state.actors.get(actor)
+        if instance is None:
+            return error_message(ValueError(f"no actor {actor!r} on this worker"))
+        try:
+            return {"status": "OK", "result": Serialize(getattr(instance, attribute))}
+        except Exception as e:
+            return error_message(e)
+
     async def plugin_add(self, plugin: Any = None, name: str | None = None) -> dict:
         plugin = unwrap(plugin)
         name = name or getattr(plugin, "name", None) or f"plugin-{len(self.plugins)}"
@@ -378,6 +417,11 @@ class Worker(Server):
                 stimulus_id=stimulus_id or seq_name("refresh"), who_has=who_has or {}
             )
         )
+
+    def _stream_pubsub_msg(self, name: str = "", msg: Any = None,
+                           **kw: Any) -> None:
+        for sub in self._pubsub_subs.get(name, ()):
+            sub._put(msg)
 
     def _stream_status_change(self, status: str = "", stimulus_id: str = "") -> None:
         if status == "paused":
@@ -466,6 +510,13 @@ class Worker(Server):
                     value = await asyncio.get_running_loop().run_in_executor(
                         self.executor, lambda: fn(*args, **kwargs)
                     )
+                if ts.actor:
+                    # keep the instance resident; the task's value is a
+                    # placeholder resolved to an Actor proxy client-side
+                    from distributed_tpu.client.actor import ActorPlaceholder
+
+                    self.state.actors[key] = value
+                    value = ActorPlaceholder(type(value), key, self.address)
             else:
                 value = unwrap(run_spec)  # literal data baked into the graph
             stop = time()

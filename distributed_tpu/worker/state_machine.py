@@ -997,7 +997,9 @@ class WorkerState:
     def _transition_flight_memory(self, ts, *, stimulus_id):
         self.in_flight_tasks.discard(ts)
         ts.coming_from = None
-        return self._put_memory(ts, stimulus_id, send_add_keys=False)
+        # add-keys tells the scheduler about the new replica — this is how
+        # AMM replication registers (reference wsm.py flight->memory)
+        return self._put_memory(ts, stimulus_id, send_add_keys=True)
 
     def _transition_flight_fetch(self, ts, *, stimulus_id):
         self.in_flight_tasks.discard(ts)

@@ -1,0 +1,89 @@
+"""Actor tests (reference test_actor.py patterns)."""
+
+from __future__ import annotations
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+
+from conftest import gen_test
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def increment(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+
+async def new_cluster(n_workers=2, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+@gen_test()
+async def test_actor_basic():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(Counter, actor=True)
+            counter = await fut.result()
+            assert await counter.increment() == 1
+            assert await counter.increment(by=10) == 11
+            assert await counter.value() == 11
+            # plain attribute access
+            assert await counter.n == 11
+
+
+@gen_test()
+async def test_actor_state_is_pinned():
+    """All calls hit the same instance on the same worker."""
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(Counter, 100, actor=True)
+            counter = await fut.result()
+            for _ in range(5):
+                await counter.increment()
+            assert await counter.value() == 105
+            # exactly one worker hosts the instance
+            hosts = [w for w in cluster.workers if w.state.actors]
+            assert len(hosts) == 1
+
+
+@gen_test()
+async def test_actor_method_error():
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor-boom")
+
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(Bad, actor=True)  # hold: actor lives with future
+            actor = await fut.result()
+            with pytest.raises(RuntimeError, match="actor-boom"):
+                await actor.boom()
+
+
+@gen_test()
+async def test_two_actors_independent():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fa = c.submit(Counter, 0, actor=True, key="actor-a")
+            fb = c.submit(Counter, 50, actor=True, key="actor-b")
+            a = await fa.result()
+            b = await fb.result()
+            await a.increment()
+            await b.increment()
+            assert await a.value() == 1
+            assert await b.value() == 51

@@ -1,0 +1,231 @@
+"""Coordination primitive tests (reference test_semaphore.py, test_locks.py,
+test_events.py, test_queues.py, test_variable.py, test_pubsub.py,
+test_publish.py patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.coordination import (
+    Event,
+    Lock,
+    MultiLock,
+    Pub,
+    Queue,
+    Semaphore,
+    Sub,
+    Variable,
+)
+from distributed_tpu.deploy.local import LocalCluster
+
+from conftest import gen_test
+
+
+async def new_cluster(n_workers=2, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+@gen_test()
+async def test_event():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            ev = Event("my-event", client=c)
+            assert not await ev.is_set()
+            assert not await ev.wait(timeout=0.05)
+
+            async def setter():
+                await asyncio.sleep(0.05)
+                await Event("my-event", client=c).set()
+
+            task = asyncio.ensure_future(setter())
+            assert await ev.wait(timeout=5)
+            assert await ev.is_set()
+            await ev.clear()
+            assert not await ev.is_set()
+            await task
+
+
+@gen_test()
+async def test_lock():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            lock1 = Lock("x", client=c)
+            lock2 = Lock("x", client=c)
+            assert await lock1.acquire()
+            assert await lock1.locked()
+            # a second holder times out while held
+            assert not await lock2.acquire(timeout=0.05)
+            await lock1.release()
+            assert await lock2.acquire(timeout=5)
+            await lock2.release()
+            # context manager form
+            async with Lock("y", client=c):
+                assert await Lock("y", client=c).locked()
+
+
+@gen_test()
+async def test_lock_reentrant_same_id():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            lock = Lock("re", client=c)
+            assert await lock.acquire()
+            assert await lock.acquire(timeout=1)  # same id: reentrant
+            await lock.release()
+
+
+@gen_test()
+async def test_multilock():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            m1 = MultiLock(["a", "b"], client=c)
+            assert await m1.acquire()
+            m2 = MultiLock(["b", "c"], client=c)
+            assert not await m2.acquire(timeout=0.05)  # blocked on b
+            await m1.release()
+            assert await m2.acquire(timeout=5)
+            await m2.release()
+
+
+@gen_test()
+async def test_semaphore():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            sem = Semaphore(max_leases=2, name="sem", client=c)
+            assert await sem.acquire()
+            assert await sem.acquire()
+            assert await sem.get_value() == 2
+            assert not await sem.acquire(timeout=0.05)  # exhausted
+            await sem.release()
+            assert await sem.acquire(timeout=5)
+            await sem.release()
+            await sem.release()
+            await sem.close()
+
+
+@gen_test()
+async def test_queue_data_roundtrip():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            q = Queue("q1", client=c)
+            await q.put({"a": 1})
+            await q.put(42)
+            assert await q.qsize() == 2
+            assert await q.get() == {"a": 1}
+            assert await q.get() == 42
+            with pytest.raises(asyncio.TimeoutError):
+                await q.get(timeout=0.05)
+            await q.close()
+
+
+@gen_test()
+async def test_queue_futures():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            q = Queue("qf", client=c)
+            fut = c.submit(lambda x: x * 3, 5, key="qf-task")
+            await fut.result()
+            await q.put(fut)
+            got = await q.get()
+            assert got.key == "qf-task"
+            assert await got.result() == 15
+
+
+@gen_test()
+async def test_variable():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            v = Variable("var1", client=c)
+            with pytest.raises(asyncio.TimeoutError):
+                await v.get(timeout=0.05)
+            await v.set(123)
+            assert await v.get() == 123
+            await v.set(456)  # overwrite
+            assert await v.get() == 456
+            fut = c.submit(lambda: "hello", key="var-task")
+            await fut.result()
+            await v.set(fut)
+            got = await v.get()
+            assert await got.result() == "hello"
+            await v.delete()
+
+
+@gen_test()
+async def test_variable_keeps_future_alive():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            v = Variable("keeper", client=c)
+            fut = c.submit(lambda: 7, key="kept-task")
+            await fut.result()
+            await v.set(fut)
+            fut.release()
+            del fut
+            await asyncio.sleep(0.1)
+            # still alive because the variable holds it
+            assert "kept-task" in cluster.scheduler.state.tasks
+            got = await v.get()
+            assert await got.result() == 7
+
+
+@gen_test()
+async def test_pubsub_client_to_client():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c1:
+            async with Client(cluster.scheduler_address) as c2:
+                sub = Sub("topic-1", client=c2)
+                await asyncio.sleep(0.05)  # let subscription register
+                pub = Pub("topic-1", client=c1)
+                pub.put({"hello": "world"})
+                msg = await sub.get(timeout=5)
+                assert msg == {"hello": "world"}
+
+
+@gen_test()
+async def test_publish_datasets():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(lambda: [1, 2, 3], key="pub-task")
+            await fut.result()
+            await c.publish_dataset("my-data", fut)
+            assert await c.list_datasets() == ["my-data"]
+            fut.release()
+            await asyncio.sleep(0.05)
+            assert "pub-task" in cluster.scheduler.state.tasks
+        # a brand-new client can retrieve it
+        async with Client(cluster.scheduler_address) as c2:
+            got = await c2.get_dataset("my-data")
+            assert await got.result() == [1, 2, 3]
+            await c2.unpublish_dataset("my-data")
+            assert await c2.list_datasets() == []
+
+
+@gen_test()
+async def test_queue_future_pending_across_clients():
+    """A Future put in a queue before it finishes must be awaitable by
+    another client (regression: unknown keys were marked finished)."""
+    import time as _t
+
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c1:
+            async with Client(cluster.scheduler_address) as c2:
+                q1 = Queue("xq", client=c1)
+                q2 = Queue("xq", client=c2)
+
+                def slow():
+                    _t.sleep(0.3)
+                    return "slow-result"
+
+                fut = c1.submit(slow, key="slow-task")
+                await q1.put(fut)  # still pending when handed over
+                got = await q2.get(timeout=5)
+                assert got.key == "slow-task"
+                assert await asyncio.wait_for(got.result(), 10) == "slow-result"

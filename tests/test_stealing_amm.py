@@ -1,0 +1,139 @@
+"""Work stealing + Active Memory Manager tests (reference test_steal.py,
+test_active_memory_manager.py patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+
+from conftest import gen_test
+
+
+def slowinc(x, delay=0.05):
+    _time.sleep(delay)
+    return x + 1
+
+
+async def new_cluster(n_workers=2, threads_per_worker=1, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        threads_per_worker=threads_per_worker,
+        scheduler_kwargs={"validate": True, **kwargs.pop("scheduler_kwargs", {})},
+        worker_kwargs={"validate": True, **kwargs.pop("worker_kwargs", {})},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+@gen_test()
+async def test_steal_time_ratio_levels():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            ext = cluster.scheduler.extensions["stealing"]
+            fut = c.submit(slowinc, 1, key="str-x")
+            await fut.result()
+            state = cluster.scheduler.state
+            ts = state.tasks["str-x"]
+            # no dependencies -> trivially stealable at level 0
+            assert ext.steal_time_ratio(ts) == (0, 0)
+
+
+@gen_test()
+async def test_stealing_rebalances_load():
+    """Tasks assigned to a busy worker migrate to an idle newcomer."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            # a pile of slow tasks all queued on the only worker
+            futs = c.map(slowinc, range(20), delay=0.1, pure=False)
+            await asyncio.sleep(0.15)  # let them assign + first ones start
+            w2 = await cluster.add_worker(name="late-joiner")
+            results = await asyncio.wait_for(c.gather(futs), 30)
+            assert results == list(range(1, 21))
+            # the late joiner must have ended up doing some of the work
+            # (either via queue-spill on join or stealing)
+            assert len(w2.data) > 0 or cluster.scheduler.extensions[
+                "stealing"
+            ].count > 0
+
+
+@gen_test()
+async def test_steal_respects_restrictions():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            w0 = cluster.workers[0].address
+            futs = c.map(
+                slowinc, range(6), delay=0.05, workers=[w0], pure=False
+            )
+            await c.gather(futs)
+            # all ran on w0 despite w1 being idle
+            assert len(cluster.workers[0].data) == 6
+            assert len(cluster.workers[1].data) == 0
+
+
+@gen_test()
+async def test_amm_reduce_replicas():
+    async with await new_cluster(n_workers=3) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(slowinc, 1, key="amm-x", delay=0.01)
+            await fut.result()
+            sched = cluster.scheduler
+            state = sched.state
+            ts = state.tasks["amm-x"]
+            # replicate everywhere
+            await sched.replicate(keys=["amm-x"], n=3)
+            for _ in range(200):
+                if len(ts.who_has) == 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(ts.who_has) == 3
+            # AMM round should trim back to 1 (no waiters)
+            amm = sched.extensions["amm"]
+            for _ in range(200):
+                amm.run_once()
+                await asyncio.sleep(0.01)
+                if len(ts.who_has) == 1:
+                    break
+            assert len(ts.who_has) == 1
+            # the data is still gatherable
+            assert await fut.result() == 2
+
+
+@gen_test()
+async def test_retire_workers_moves_unique_data():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(slowinc, range(8), delay=0.01, pure=False)
+            await c.gather(futs)
+            victim = cluster.workers[0].address
+            retired = await cluster.scheduler.retire_workers(workers=[victim])
+            assert retired == [victim]
+            cluster.workers = [
+                w for w in cluster.workers if w.address != victim
+            ]
+            # every result survives on the remaining worker
+            results = await asyncio.wait_for(c.gather(futs), 15)
+            assert results == list(range(1, 9))
+
+
+@gen_test()
+async def test_amm_respects_processing_waiters():
+    """A replica about to be consumed by a processing dependent is not
+    dropped from that worker."""
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(slowinc, 1, key="amm-dep", delay=0.01)
+            await fut.result()
+            state = cluster.scheduler.state
+            ts = state.tasks["amm-dep"]
+            assert len(ts.who_has) == 1  # single replica: never dropped
+            amm = cluster.scheduler.extensions["amm"]
+            amm.run_once()
+            await asyncio.sleep(0.1)
+            assert len(ts.who_has) == 1
+            assert await fut.result() == 2
