@@ -46,14 +46,10 @@ def __getattr__(name: str):
         from distributed_tpu.deploy.local import LocalCluster
 
         return LocalCluster
-    if name == "SpecCluster":
-        from distributed_tpu.deploy.spec import SpecCluster
+    if name in ("SpecCluster", "Adaptive", "Cluster"):
+        from distributed_tpu.deploy import spec as _spec
 
-        return SpecCluster
-    if name == "Adaptive":
-        from distributed_tpu.deploy.adaptive import Adaptive
-
-        return Adaptive
+        return getattr(_spec, name)
     if name in ("Semaphore", "Lock", "MultiLock", "Event", "Queue", "Variable", "Pub", "Sub"):
         from distributed_tpu import coordination as _coord
 

@@ -1,0 +1,81 @@
+"""``dtpu-scheduler``: run a scheduler process (reference cli/dask_scheduler.py).
+
+    python -m distributed_tpu.cli.scheduler --host 0.0.0.0 --port 8786
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtpu-scheduler", description="distributed_tpu scheduler"
+    )
+    p.add_argument("--host", default="127.0.0.1", help="listen host")
+    p.add_argument("--port", type=int, default=8786, help="listen port (0=random)")
+    p.add_argument("--protocol", default="tcp", help="comm protocol (tcp, tls)")
+    p.add_argument("--idle-timeout", default=None,
+                   help="shut down after this long with no activity (e.g. '5min')")
+    p.add_argument("--worker-ttl", default=None,
+                   help="evict workers silent for this long")
+    p.add_argument("--preload", action="append", default=[],
+                   help="module to import (dtpu_setup hook) at startup")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--version", action="store_true")
+    return p
+
+
+async def run(args: argparse.Namespace) -> int:
+    from distributed_tpu import config
+    from distributed_tpu.preloading import process_preloads
+    from distributed_tpu.scheduler.server import Scheduler
+
+    kwargs = {}
+    if args.idle_timeout is not None:
+        kwargs["idle_timeout"] = config.parse_timedelta(args.idle_timeout)
+    if args.worker_ttl is not None:
+        kwargs["worker_ttl"] = config.parse_timedelta(args.worker_ttl)
+    scheduler = Scheduler(
+        listen_addr=f"{args.protocol}://{args.host}:{args.port}", **kwargs
+    )
+    preloads = process_preloads(scheduler, args.preload)
+    for preload in preloads:
+        await preload.start()
+    await scheduler.start()
+    print(f"Scheduler at: {scheduler.address}", flush=True)
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    finished = asyncio.ensure_future(scheduler.finished())
+    stopper = asyncio.ensure_future(stop.wait())
+    await asyncio.wait({finished, stopper}, return_when=asyncio.FIRST_COMPLETED)
+    for preload in preloads:
+        await preload.teardown()
+    await scheduler.close()
+    stopper.cancel()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.version:
+        from distributed_tpu import __version__
+
+        print(__version__)
+        return 0
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""``dtpu-worker``: run worker process(es) (reference cli/dask_worker.py).
+
+    python -m distributed_tpu.cli.worker tcp://127.0.0.1:8786 \
+        --nworkers 2 --nthreads 1 --nanny
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import sys
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtpu-worker", description="distributed_tpu worker"
+    )
+    p.add_argument("scheduler", help="scheduler address (tcp://host:port)")
+    p.add_argument("--nthreads", type=int, default=1, help="threads per worker")
+    p.add_argument("--nworkers", default="1",
+                   help="number of worker processes ('auto' = cpu count)")
+    p.add_argument("--name", default=None, help="worker name prefix")
+    p.add_argument("--memory-limit", default="0",
+                   help="bytes of memory per worker before spilling")
+    p.add_argument("--resources", default=None,
+                   help='JSON dict of abstract resources, e.g. \'{"GPU": 2}\'')
+    p.add_argument("--nanny", action="store_true", default=False,
+                   help="run each worker under a nanny (auto-restart)")
+    p.add_argument("--no-nanny", dest="nanny", action="store_false")
+    p.add_argument("--preload", action="append", default=[],
+                   help="module to import (dtpu_setup hook) at startup")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--version", action="store_true")
+    return p
+
+
+async def run(args: argparse.Namespace) -> int:
+    import os
+
+    from distributed_tpu import config
+    from distributed_tpu.preloading import process_preloads
+    from distributed_tpu.worker.nanny import Nanny
+    from distributed_tpu.worker.server import Worker
+
+    nworkers = (
+        os.cpu_count() or 1 if args.nworkers == "auto" else int(args.nworkers)
+    )
+    resources = json.loads(args.resources) if args.resources else None
+    memory_limit = config.parse_bytes(args.memory_limit)
+
+    servers = []
+    all_preloads = []
+    for i in range(nworkers):
+        name = (
+            f"{args.name}-{i}" if args.name and nworkers > 1
+            else args.name or None
+        )
+        if args.nanny:
+            server = Nanny(
+                args.scheduler,
+                nthreads=args.nthreads,
+                name=name,
+                memory_limit=memory_limit,
+                worker_kwargs={"resources": resources} if resources else {},
+            )
+        else:
+            server = Worker(
+                args.scheduler,
+                nthreads=args.nthreads,
+                name=name,
+                memory_limit=memory_limit,
+                resources=resources,
+            )
+        preloads = process_preloads(server, args.preload)
+        for preload in preloads:
+            await preload.start()
+        all_preloads.extend(preloads)
+        await server.start()
+        servers.append(server)
+        addr = getattr(server, "worker_address", None) or server.address
+        print(f"Worker at: {addr}", flush=True)
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    waiters = [asyncio.ensure_future(s.finished()) for s in servers]
+    stopper = asyncio.ensure_future(stop.wait())
+    await asyncio.wait({*waiters, stopper}, return_when=asyncio.FIRST_COMPLETED)
+    for preload in all_preloads:
+        await preload.teardown()
+    for s in servers:
+        await s.close()
+    stopper.cancel()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.version:
+        from distributed_tpu import __version__
+
+        print(__version__)
+        return 0
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -592,7 +592,8 @@ class Scheduler(Server):
             self.state.running.add(ws)
             self.state.check_idle_saturated(ws)
             stimulus_id = stimulus_id or seq_name("worker-unpaused")
-            recs = self.state.stimulus_queue_slots_maybe_opened(stimulus_id)
+            recs = self.state.bulk_schedule_unrunnable_after_adding_worker(ws)
+            recs.update(self.state.stimulus_queue_slots_maybe_opened(stimulus_id))
             client_msgs, worker_msgs = self.state.transitions(recs, stimulus_id)
             self.send_all(client_msgs, worker_msgs)
 

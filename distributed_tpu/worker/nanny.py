@@ -1,0 +1,243 @@
+"""Nanny: supervises a Worker subprocess (reference nanny.py).
+
+The Nanny is a small Server that spawns the real Worker in a child
+process (spawn context), reports its address back, restarts it when it
+dies unexpectedly (reference ``_on_worker_exit`` nanny.py:546), and kills
+it with escalation (graceful close -> SIGTERM -> SIGKILL, nanny.py:393).
+Scheduler-initiated restarts go through the ``restart``/``kill`` RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import multiprocessing
+import os
+from typing import Any
+
+from distributed_tpu import config
+from distributed_tpu.rpc.core import Server, Status
+from distributed_tpu.worker.process import AsyncProcess
+
+logger = logging.getLogger("distributed_tpu.nanny")
+
+
+def _run_worker_process(scheduler_addr: str, worker_kwargs: dict,
+                        env: dict, q: multiprocessing.Queue) -> None:
+    """Child-process entry: run a Worker until it closes."""
+    for k, v in env.items():
+        os.environ[k] = str(v)
+
+    import asyncio as _asyncio
+
+    async def main() -> None:
+        from distributed_tpu.worker.server import Worker
+
+        worker = Worker(scheduler_addr, **worker_kwargs)
+        try:
+            await worker.start()
+        except Exception as e:  # startup failure: tell the parent
+            q.put({"op": "start-failed", "error": repr(e)})
+            raise
+        q.put({"op": "started", "address": worker.address})
+        await worker.finished()
+
+    try:
+        _asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+
+
+class Nanny(Server):
+    """Worker supervisor process (reference nanny.py:69)."""
+
+    def __init__(
+        self,
+        scheduler_addr: str,
+        *,
+        nthreads: int = 1,
+        name: object = None,
+        memory_limit: int = 0,
+        auto_restart: bool = True,
+        worker_kwargs: dict | None = None,
+        env: dict | None = None,
+        listen_addr: str | None = None,
+        **server_kwargs: Any,
+    ):
+        self.scheduler_addr = scheduler_addr
+        self.nthreads = nthreads
+        self.worker_name = name
+        self.memory_limit = memory_limit
+        self.auto_restart = auto_restart
+        self.env = dict(config.get("nanny.environ") or {})
+        self.env.update(env or {})
+        self.worker_kwargs = dict(worker_kwargs or {})
+        self._listen_addr = listen_addr
+        self.process: AsyncProcess | None = None
+        self.worker_address: str | None = None
+        self._start_queue: multiprocessing.Queue | None = None
+        self._restart_attempts = 0
+        self.MAX_RESTART_ATTEMPTS = 3
+
+        handlers = {
+            "instantiate": self.instantiate_rpc,
+            "kill": self.kill_rpc,
+            "restart": self.restart_rpc,
+            "terminate": self.close_rpc,
+            "worker_address": self.get_worker_address,
+        }
+        super().__init__(handlers=handlers, name=name, **server_kwargs)
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start_unsafe(self) -> "Nanny":
+        addr = self._listen_addr or "tcp://127.0.0.1:0"
+        await self.listen(addr)
+        await self.instantiate()
+        if self.memory_limit:
+            from distributed_tpu.worker.memory import NannyMemoryManager
+
+            self.memory_manager = NannyMemoryManager(self, self.memory_limit)
+        self.start_periodic_callbacks()
+        return self
+
+    async def instantiate(self, timeout: float = 60.0) -> str:
+        """Spawn the worker subprocess, wait for its address
+        (reference nanny.py:363 / WorkerProcess.start nanny.py:708)."""
+        ctx = multiprocessing.get_context("spawn")
+        q: multiprocessing.Queue = ctx.Queue()
+        self._start_queue = q
+        kwargs = dict(self.worker_kwargs)
+        kwargs.setdefault("nthreads", self.nthreads)
+        kwargs.setdefault("name", self.worker_name)
+        kwargs.setdefault("memory_limit", self.memory_limit)
+        env = dict(config.get("nanny.pre-spawn-environ") or {})
+        env.update(self.env)
+        self.process = AsyncProcess(
+            target=_run_worker_process,
+            args=(self.scheduler_addr, kwargs, env, q),
+            name=f"dtpu-worker-{self.worker_name or self.id}",
+        )
+        self.process.set_exit_callback(self._on_worker_exit)
+        await self.process.start()
+        loop = asyncio.get_running_loop()
+        import queue as _queue
+
+        # q.get with its own timeout so the executor thread always exits
+        def _get_startup_msg():
+            try:
+                return q.get(timeout=timeout)
+            except _queue.Empty:
+                return None
+
+        msg = await loop.run_in_executor(None, _get_startup_msg)
+        if msg is None:
+            # child hung during startup: reap it, don't leak the process
+            self.process.set_exit_callback(lambda code: None)
+            await self.process.kill()
+            raise TimeoutError(
+                f"worker did not start within {timeout}s; killed pid "
+                f"{self.process.pid}"
+            )
+        if msg.get("op") != "started":
+            raise RuntimeError(f"worker failed to start: {msg!r}")
+        self._restart_attempts = 0
+        self.worker_address = msg["address"]
+        logger.info(
+            "nanny %s started worker %s (pid %s)",
+            self.address, self.worker_address, self.process.pid,
+        )
+        return self.worker_address
+
+    def _on_worker_exit(self, exitcode: int | None) -> None:
+        """The worker process died (reference nanny.py:546)."""
+        if self.status in (Status.closing, Status.closed, Status.failed):
+            return
+        logger.warning(
+            "worker process %s exited with code %s", self.worker_address, exitcode
+        )
+        if self.auto_restart:
+            logger.info("nanny restarting worker")
+            self._ongoing_background_tasks.call_soon(self._restart_on_exit)
+
+    async def _restart_on_exit(self) -> None:
+        self._restart_attempts += 1
+        if self._restart_attempts > self.MAX_RESTART_ATTEMPTS:
+            logger.error(
+                "worker failed to start %d times; nanny giving up",
+                self._restart_attempts - 1,
+            )
+            self.status = Status.failed
+            return
+        await asyncio.sleep(0.5 * self._restart_attempts)  # backoff
+        try:
+            await self.instantiate()
+        except Exception:
+            logger.exception("nanny failed to restart worker")
+            self._on_worker_exit(None)
+
+    async def kill(self, timeout: float = 5.0, *, graceful: bool = True) -> None:
+        """Stop the worker with escalation (reference nanny.py:393)."""
+        process = self.process
+        if process is None or not process.is_alive():
+            return
+        process.set_exit_callback(lambda code: None)  # no auto-restart
+        if graceful and self.worker_address:
+            from distributed_tpu.exceptions import CommClosedError
+
+            try:
+                await asyncio.wait_for(
+                    self.rpc(self.worker_address).terminate(), timeout / 2
+                )
+            except (CommClosedError, OSError, asyncio.TimeoutError, RuntimeError):
+                pass
+        try:
+            await asyncio.wait_for(process.join(), timeout / 2)
+            return
+        except asyncio.TimeoutError:
+            pass
+        await process.terminate()
+        try:
+            await asyncio.wait_for(process.join(), timeout / 2)
+            return
+        except asyncio.TimeoutError:
+            pass
+        logger.warning("escalating to SIGKILL for pid %s", process.pid)
+        await process.kill()
+        await process.join()
+
+    async def restart(self, timeout: float = 30.0) -> str:
+        await self.kill(timeout=timeout / 2)
+        return await self.instantiate(timeout=timeout)
+
+    async def close(self, timeout: float | None = None) -> None:
+        if self.status in (Status.closed, Status.closing):
+            await self.finished()
+            return
+        self.status = Status.closing
+        logger.info("closing nanny %s", self.address)
+        await self.kill()
+        await super().close()
+
+    # ------------------------------------------------------------- handlers
+
+    async def instantiate_rpc(self) -> str:
+        return await self.instantiate()
+
+    async def kill_rpc(self, timeout: float = 5.0) -> str:
+        await self.kill(timeout=timeout)
+        return "OK"
+
+    async def restart_rpc(self, timeout: float = 30.0) -> str:
+        await self.restart(timeout=timeout)
+        return "OK"
+
+    async def close_rpc(self, reason: str = "") -> str:
+        self._ongoing_background_tasks.call_soon(self.close)
+        return "OK"
+
+    async def get_worker_address(self) -> str | None:
+        return self.worker_address
+
+    def __repr__(self) -> str:
+        return f"<Nanny worker={self.worker_address!r} status={self.status.name}>"

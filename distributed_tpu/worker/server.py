@@ -83,10 +83,17 @@ class Worker(Server):
         self.nthreads = nthreads or 1
         self.memory_limit = memory_limit
         self._listen_addr = listen_addr
+        data = None
+        if memory_limit:
+            from distributed_tpu.worker.spill import SpillBuffer
+
+            mem_cfg = config.get("worker.memory")
+            data = SpillBuffer(target=int(mem_cfg["target"] * memory_limit))
         self.state = WorkerState(
             nthreads=self.nthreads,
             resources=resources,
             validate=validate,
+            data=data,
         )
         self.data = self.state.data
         self.executor = ThreadPoolExecutor(
@@ -134,6 +141,11 @@ class Worker(Server):
             **server_kwargs,
         )
         self.name = name if name is not None else self.id
+        self.memory_manager = None
+        if memory_limit:
+            from distributed_tpu.worker.memory import WorkerMemoryManager
+
+            self.memory_manager = WorkerMemoryManager(self, memory_limit)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -240,6 +252,8 @@ class Worker(Server):
             await self.scheduler_comm.close()
         self.executor.shutdown(wait=False)
         self.actor_executor.shutdown(wait=False)
+        if hasattr(self.data, "close"):
+            self.data.close()
         await super().close()
 
     async def close_rpc(self, reason: str = "") -> str:

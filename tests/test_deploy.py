@@ -1,0 +1,136 @@
+"""Deploy layer tests: SpecCluster, Adaptive, CLI (reference deploy/tests,
+cli/tests patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.spec import Adaptive, SpecCluster
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
+
+from conftest import gen_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI_ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+    "JAX_NUM_CPU_DEVICES": "1",
+}
+
+
+@gen_test()
+async def test_spec_cluster_reconciles():
+    async with SpecCluster(
+        workers={
+            "a": {"cls": Worker, "options": {"nthreads": 1, "listen_addr": "inproc://"}},
+            "b": {"cls": Worker, "options": {"nthreads": 1, "listen_addr": "inproc://"}},
+        },
+        scheduler={"cls": Scheduler, "options": {"listen_addr": "inproc://",
+                                                 "validate": True}},
+        worker={"cls": Worker, "options": {"nthreads": 1, "listen_addr": "inproc://"}},
+    ) as cluster:
+        assert sorted(cluster.workers) == ["a", "b"]
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 1, range(8))
+            assert await c.gather(futs) == list(range(1, 9))
+        # scale up then down through the spec
+        await cluster.scale(4)
+        assert len(cluster.workers) == 4
+        assert len(cluster.scheduler.state.workers) == 4
+        await cluster.scale(1)
+        assert len(cluster.workers) == 1
+        for _ in range(100):
+            if len(cluster.scheduler.state.workers) == 1:
+                break
+            await asyncio.sleep(0.02)
+        assert len(cluster.scheduler.state.workers) == 1
+
+
+@gen_test()
+async def test_adaptive_scales_up_and_down():
+    import time as _t
+
+    adaptive = Adaptive(minimum=1, maximum=4, interval=0.05, wait_count=2,
+                        target_duration=0.5)
+    async with SpecCluster(
+        workers={},
+        scheduler={"cls": Scheduler, "options": {"listen_addr": "inproc://"}},
+        worker={"cls": Worker, "options": {"nthreads": 1, "listen_addr": "inproc://"}},
+        adaptive=adaptive,
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            # queue slow work: adaptive must scale up from 0
+            futs = c.map(lambda x: (_t.sleep(0.2), x)[1], range(8), pure=False)
+            for _ in range(200):
+                if len(cluster.workers) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(cluster.workers) >= 2
+            assert await asyncio.wait_for(c.gather(futs), 30) == list(range(8))
+        # idle: must shrink to minimum
+        for _ in range(200):
+            if len(cluster.workers) <= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(cluster.workers) <= 1
+        assert any(entry[0] == "up" for entry in adaptive.log)
+
+
+@pytest.mark.slow
+def test_cli_scheduler_and_worker_roundtrip():
+    """Spawn real dtpu-scheduler / dtpu-worker processes (reference
+    cli/tests)."""
+    sched = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=CLI_ENV, cwd=REPO,
+    )
+    worker = None
+    try:
+        line = sched.stdout.readline()
+        assert line.startswith("Scheduler at:"), line
+        address = line.split()[-1]
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tpu.cli.worker", address,
+             "--nthreads", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=CLI_ENV, cwd=REPO,
+        )
+        wline = worker.stdout.readline()
+        assert wline.startswith("Worker at:"), wline
+
+        async def drive():
+            async with Client(address) as c:
+                fut = c.submit(lambda x: x * 7, 6)
+                return await asyncio.wait_for(fut.result(), 30)
+
+        assert asyncio.run(drive()) == 42
+    finally:
+        for proc in (worker, sched):
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (worker, sched):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+@pytest.mark.slow
+def test_cli_version():
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--version"],
+        capture_output=True, text=True, env=CLI_ENV, cwd=REPO,
+    )
+    assert out.returncode == 0
+    assert out.stdout.strip()

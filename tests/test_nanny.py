@@ -1,0 +1,69 @@
+"""Nanny / subprocess worker tests (reference test_nanny.py patterns).
+
+Tier-3 style: real child processes over tcp.  Kept few and small — each
+spawn pays the interpreter + jax import cost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.nanny import Nanny
+
+from conftest import gen_test
+
+pytestmark = pytest.mark.slow
+
+CHILD_ENV = {"JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1"}
+
+
+@gen_test(timeout=120)
+async def test_nanny_runs_worker_and_restarts_on_death():
+    async with Scheduler(validate=True) as s:
+        nanny = Nanny(s.address, nthreads=1, name="nanny-w0", env=CHILD_ENV)
+        async with nanny:
+            assert nanny.worker_address is not None
+            for _ in range(100):
+                if s.state.workers:
+                    break
+                await asyncio.sleep(0.1)
+            assert nanny.worker_address in s.state.workers
+
+            async with Client(s.address) as c:
+                fut = c.submit(lambda x: x + 1, 1)
+                assert await asyncio.wait_for(fut.result(), 30) == 2
+
+                # hard-kill the worker process: nanny must respawn it
+                old_pid = nanny.process.pid
+                os.kill(old_pid, signal.SIGKILL)
+                for _ in range(300):
+                    if (
+                        nanny.process is not None
+                        and nanny.process.pid not in (None, old_pid)
+                        and nanny.worker_address in s.state.workers
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                assert nanny.process.pid != old_pid
+
+                fut2 = c.submit(lambda x: x * 10, 5, pure=False)
+                assert await asyncio.wait_for(fut2.result(), 30) == 50
+
+
+@gen_test(timeout=120)
+async def test_nanny_graceful_kill_no_restart():
+    async with Scheduler(validate=True) as s:
+        nanny = Nanny(s.address, nthreads=1, name="nanny-w1", env=CHILD_ENV)
+        async with nanny:
+            pid = nanny.process.pid
+            await nanny.kill()
+            assert not nanny.process.is_alive()
+            await asyncio.sleep(0.5)
+            # no auto-restart after an explicit kill
+            assert nanny.process.pid == pid

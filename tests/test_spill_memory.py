@@ -1,0 +1,123 @@
+"""Spill buffer + worker memory management tests (reference test_spill.py,
+test_worker_memory.py patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.worker.spill import SpillBuffer
+
+from conftest import gen_test
+
+
+def test_spill_buffer_basic(tmp_path):
+    buf = SpillBuffer(str(tmp_path / "spill"), target=0)
+    buf["a"] = b"x" * 1000
+    buf["b"] = list(range(100))
+    assert len(buf) == 2
+    assert buf["a"] == b"x" * 1000
+    assert sorted(buf) == ["a", "b"]
+    del buf["a"]
+    assert "a" not in buf
+    with pytest.raises(KeyError):
+        buf["a"]
+    buf.close()
+
+
+def test_spill_buffer_evicts_lru(tmp_path):
+    buf = SpillBuffer(str(tmp_path / "spill"), target=0)
+    buf["a"] = b"a" * 10_000
+    buf["b"] = b"b" * 10_000
+    buf["c"] = b"c" * 10_000
+    _ = buf["a"]  # touch: a becomes most-recent
+    freed = buf.evict()  # LRU is b
+    assert freed > 0
+    assert "b" in buf.slow and "b" not in buf.fast
+    assert buf.spilled_count == 1
+    # read-through unspills and promotes
+    assert buf["b"] == b"b" * 10_000
+    assert "b" in buf.fast and "b" not in buf.slow
+    assert buf.unspilled_count == 1
+    buf.close()
+
+
+def test_spill_buffer_target_auto_evicts(tmp_path):
+    buf = SpillBuffer(str(tmp_path / "spill"), target=25_000)
+    for i in range(5):
+        buf[f"k{i}"] = b"v" * 10_000
+    # fast layer must have shrunk to the budget; nothing lost
+    assert buf.fast_bytes <= 25_000
+    assert len(buf) == 5
+    for i in range(5):
+        assert buf[f"k{i}"] == b"v" * 10_000
+    buf.close()
+
+
+def test_spill_buffer_overwrite_accounting(tmp_path):
+    buf = SpillBuffer(str(tmp_path / "spill"))
+    buf["k"] = b"x" * 1000
+    b1 = buf.fast_bytes
+    buf["k"] = b"x" * 2000
+    assert buf.fast_bytes > b1
+    assert len(buf) == 1
+    buf.close()
+
+
+@gen_test()
+async def test_cluster_serves_spilled_data():
+    """Data evicted to disk is still gatherable and usable as a dependency."""
+    async with LocalCluster(
+        n_workers=1,
+        worker_kwargs={"memory_limit": 10**12, "validate": True},
+        scheduler_kwargs={"validate": True},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(lambda: b"payload" * 1000, key="spillme")
+            assert (await fut.result())[:7] == b"payload"
+            worker = cluster.workers[0]
+            assert hasattr(worker.data, "evict")
+            # force the key to disk
+            while "spillme" in worker.data.fast:
+                worker.data.evict()
+            assert "spillme" in worker.data.slow
+            # gather reads through the slow layer
+            assert (await fut.result())[:7] == b"payload"
+            # and dependent tasks can consume it
+            ln = c.submit(len, fut)
+            assert await ln.result() == 7000
+
+
+@gen_test()
+async def test_paused_worker_stops_executing():
+    """A paused worker defers ready tasks until unpaused."""
+    async with LocalCluster(
+        n_workers=1, scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+    ) as cluster:
+        worker = cluster.workers[0]
+        from distributed_tpu.utils.misc import seq_name
+        from distributed_tpu.worker.state_machine import PauseEvent, UnpauseEvent
+
+        async with Client(cluster.scheduler_address) as c:
+            worker.handle_stimulus(PauseEvent(stimulus_id=seq_name("test-pause")))
+            worker.batched_stream.send(
+                {"op": "worker-status-change", "status": "paused",
+                 "stimulus_id": "test-pause"}
+            )
+            await asyncio.sleep(0.05)
+            # scheduler took it out of the running pool
+            assert not cluster.scheduler.state.running
+            fut = c.submit(lambda: 11, key="paused-task")
+            await asyncio.sleep(0.1)
+            assert not fut.done()
+            worker.handle_stimulus(UnpauseEvent(stimulus_id=seq_name("test-unpause")))
+            worker.batched_stream.send(
+                {"op": "worker-status-change", "status": "running",
+                 "stimulus_id": "test-unpause"}
+            )
+            assert await asyncio.wait_for(fut.result(), 10) == 11
