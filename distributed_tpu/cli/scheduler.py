@@ -43,10 +43,11 @@ async def run(args: argparse.Namespace) -> int:
     scheduler = Scheduler(
         listen_addr=f"{args.protocol}://{args.host}:{args.port}", **kwargs
     )
+    await scheduler.start()
+    # preloads run with the server live (dtpu_setup may read .address)
     preloads = process_preloads(scheduler, args.preload)
     for preload in preloads:
         await preload.start()
-    await scheduler.start()
     print(f"Scheduler at: {scheduler.address}", flush=True)
 
     loop = asyncio.get_running_loop()

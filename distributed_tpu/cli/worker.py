@@ -74,11 +74,12 @@ async def run(args: argparse.Namespace) -> int:
                 memory_limit=memory_limit,
                 resources=resources,
             )
+        await server.start()
+        # preloads run with the server live (dtpu_setup may read .address)
         preloads = process_preloads(server, args.preload)
         for preload in preloads:
             await preload.start()
         all_preloads.extend(preloads)
-        await server.start()
         servers.append(server)
         addr = getattr(server, "worker_address", None) or server.address
         print(f"Worker at: {addr}", flush=True)

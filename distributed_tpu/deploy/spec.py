@@ -88,7 +88,8 @@ class SpecCluster(Cluster):
             to_close = [
                 name for name in self.workers if name not in self.worker_spec
             ]
-            for name in to_close:
+
+            async def _close_one(name: str) -> None:
                 w = self.workers.pop(name)
                 addr = getattr(w, "worker_address", None) or getattr(
                     w, "address", None
@@ -96,16 +97,26 @@ class SpecCluster(Cluster):
                 if addr is not None and self.scheduler is not None:
                     await self.scheduler.retire_workers(workers=[addr])
                 await w.close()
-            # start workers in the spec but not yet live
-            for name, spec in list(self.worker_spec.items()):
-                if name in self.workers:
-                    continue
+
+            if to_close:
+                await asyncio.gather(*(_close_one(n) for n in to_close))
+
+            # start workers in the spec but not yet live — concurrently,
+            # so scale(N) pays ~one worker's startup latency
+            async def _start_one(name: str, spec: dict) -> None:
                 cls = spec["cls"]
                 opts = dict(spec.get("options", {}))
                 opts.setdefault("name", name)
                 worker = cls(self.scheduler.address, **opts)
                 await worker.start()
                 self.workers[name] = worker
+
+            pending = [
+                (n, s) for n, s in self.worker_spec.items()
+                if n not in self.workers
+            ]
+            if pending:
+                await asyncio.gather(*(_start_one(n, s) for n, s in pending))
 
     def _new_worker_name(self) -> str:
         while True:

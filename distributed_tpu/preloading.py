@@ -20,24 +20,20 @@ from typing import Any
 logger = logging.getLogger("distributed_tpu.preload")
 
 
+def _exec_module(source: str, filename: str, key: str) -> types.ModuleType:
+    name = f"_dtpu_preload_{abs(hash(key)) % 10**8}"
+    module = types.ModuleType(name)
+    exec(compile(source, filename, "exec"), module.__dict__)
+    sys.modules[name] = module
+    return module
+
+
 def _load_module(spec: str) -> types.ModuleType:
     if spec.endswith(".py") or os.path.sep in spec and os.path.exists(spec):
-        # a file path: exec it as an anonymous module
-        name = f"_dtpu_preload_{abs(hash(spec)) % 10**8}"
-        module = types.ModuleType(name)
         with open(spec) as f:
-            source = f.read()
-        code = compile(source, spec, "exec")
-        exec(code, module.__dict__)
-        sys.modules[name] = module
-        return module
+            return _exec_module(f.read(), spec, spec)
     if "\n" in spec or ";" in spec:
-        # raw source text
-        name = f"_dtpu_preload_{abs(hash(spec)) % 10**8}"
-        module = types.ModuleType(name)
-        exec(compile(spec, "<preload>", "exec"), module.__dict__)
-        sys.modules[name] = module
-        return module
+        return _exec_module(spec, "<preload>", spec)
     return importlib.import_module(spec)
 
 

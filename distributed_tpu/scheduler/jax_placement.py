@@ -1,0 +1,191 @@
+"""JAX placement co-processor: batched decide_worker on device.
+
+The north-star integration (BASELINE.json): instead of running the
+python ``decide_worker`` min-loop per task (reference scheduler.py:8550,
+~1 ms/task), the scheduler plans a whole incoming graph in ONE device
+call at ``update_graph`` time — ``ops.wavefront.place_graph`` levelizes
+the DAG and assigns every task with a masked cost-matrix argmin per
+wavefront, entirely inside jit.  The plan is consumed as a per-task hint
+by ``decide_worker_non_rootish`` via the ``SchedulerState.placement``
+hook; any deviation (worker died, restrictions, occupancy drift) falls
+back to the python locality oracle, and WorkStealing rebalances
+dynamically — the plan is a speculative hint exactly like the
+reference's root-ish ``tg.last_worker`` co-assignment
+(reference scheduler.py:2135).
+
+Toggle via ``scheduler.jax.enabled`` / ``scheduler.jax.min-batch``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any
+
+from distributed_tpu import config
+from distributed_tpu.graph.spec import Key
+
+if TYPE_CHECKING:
+    from distributed_tpu.scheduler.state import SchedulerState, TaskState, WorkerState
+
+logger = logging.getLogger("distributed_tpu.jax_placement")
+
+_DEFAULT_NBYTES = 10_000.0  # cost-model guess for unobserved outputs
+
+
+class JaxPlacement:
+    """Whole-graph device planner behind the SchedulerState.placement hook."""
+
+    def __init__(self, min_batch: int | None = None,
+                 max_batch: int | None = None):
+        self.min_batch = (
+            min_batch if min_batch is not None
+            else config.get("scheduler.jax.min-batch")
+        )
+        self.max_batch = max_batch or 1_000_000
+        self.plan: dict[Key, str] = {}
+        self.plans_computed = 0
+        self.plan_hits = 0
+        self.plan_misses = 0
+        self.enabled = True
+
+    # ------------------------------------------------------------- hooks
+
+    def on_add_worker(self, state: "SchedulerState", ws: "WorkerState") -> None:
+        pass  # plans stay valid as hints; new workers fill via stealing
+
+    def on_remove_worker(self, state: "SchedulerState", ws: "WorkerState") -> None:
+        addr = ws.address
+        self.plan = {k: a for k, a in self.plan.items() if a != addr}
+
+    def wants(self, ts: "TaskState") -> bool:
+        return self.enabled and ts.key in self.plan
+
+    def decide_worker(
+        self,
+        state: "SchedulerState",
+        ts: "TaskState",
+        valid_workers: "set[WorkerState] | None",
+    ) -> "WorkerState | None":
+        addr = self.plan.pop(ts.key, None)
+        if addr is None:
+            return None
+        ws = state.workers.get(addr)
+        if ws is None or ws not in state.running:
+            self.plan_misses += 1
+            return None
+        if valid_workers is not None and ws not in valid_workers:
+            self.plan_misses += 1
+            return None
+        self.plan_hits += 1
+        return ws
+
+    # ---------------------------------------------------------- planning
+
+    def plan_graph(self, state: "SchedulerState",
+                   tasks: "dict[Key, TaskState]") -> int:
+        """One device call placing the whole batch; returns tasks planned."""
+        if not self.enabled:
+            return 0
+        # drop stale hints first: keys gone from the scheduler or no
+        # longer pending will never be consulted and would accumulate
+        if self.plan:
+            self.plan = {
+                k: a
+                for k, a in self.plan.items()
+                if (pts := state.tasks.get(k)) is not None
+                and pts.state in ("released", "waiting", "queued", "no-worker")
+            }
+        # plan only runnable *pending* tasks whose dependencies are inside
+        # the batch (external deps already sit on specific workers: the
+        # python locality oracle is the right tool for those few), and
+        # skip root-ish tasks — the rootish co-assignment paths never
+        # consult the placement hook
+        batch: list[TaskState] = []
+        keyset = set(tasks)
+        for ts in tasks.values():
+            if ts.run_spec is None or ts.actor or ts.has_restrictions:
+                continue
+            if ts.state not in ("released", "waiting"):
+                continue
+            if state.is_rootish(ts):
+                continue
+            if all(dts.key in keyset for dts in ts.dependencies):
+                batch.append(ts)
+        if len(batch) < self.min_batch or len(batch) > self.max_batch:
+            return 0
+        workers = [ws for ws in state.workers.values()]
+        if len(workers) < 2:
+            return 0
+        try:
+            plan = self._device_plan(state, batch, workers)
+        except Exception:
+            logger.exception("device planning failed; disabling co-processor")
+            self.enabled = False
+            return 0
+        self.plan.update(plan)
+        self.plans_computed += 1
+        logger.debug("planned %d tasks on device", len(plan))
+        return len(plan)
+
+    def _device_plan(self, state: "SchedulerState", batch: list,
+                     workers: list) -> dict[Key, str]:
+        import numpy as np
+
+        from distributed_tpu.ops.placement import pad_to_bucket
+        from distributed_tpu.ops.wavefront import GraphArrays, place_graph
+
+        n = len(batch)
+        index = {ts.key: i for i, ts in enumerate(batch)}
+        durations = np.empty(n, np.float32)
+        out_bytes = np.empty(n, np.float32)
+        src: list[int] = []
+        dst: list[int] = []
+        for i, ts in enumerate(batch):
+            durations[i] = state.get_task_duration(ts)
+            nbytes = ts.nbytes
+            if nbytes < 0 and ts.prefix is not None and ts.prefix.nbytes_total:
+                counts = sum(ts.prefix.state_counts.values()) or 1
+                nbytes = ts.prefix.nbytes_total / counts
+            out_bytes[i] = nbytes if nbytes and nbytes > 0 else _DEFAULT_NBYTES
+            for dts in ts.dependencies:
+                j = index.get(dts.key)
+                if j is not None:
+                    src.append(j)
+                    dst.append(i)
+
+        import jax.numpy as jnp
+
+        g = GraphArrays.from_arrays(
+            durations,
+            out_bytes,
+            np.asarray(src, np.int64),
+            np.asarray(dst, np.int64),
+            pad_tasks=pad_to_bucket(n),
+            pad_edges=pad_to_bucket(max(len(src), 1)),
+        )
+        nthreads = jnp.asarray(
+            [ws.nthreads for ws in workers], jnp.int32
+        )
+        occupancy = jnp.asarray(
+            [ws.occupancy for ws in workers], jnp.float32
+        )
+        running = jnp.asarray(
+            [ws in state.running for ws in workers], bool
+        )
+        result = place_graph(
+            g, nthreads, occupancy, running, bandwidth=state.bandwidth
+        )
+        assignment = np.asarray(result.assignment)[:n]
+        addrs = [ws.address for ws in workers]
+        return {
+            ts.key: addrs[int(assignment[i])]
+            for i, ts in enumerate(batch)
+            if 0 <= assignment[i] < len(addrs)
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"<JaxPlacement plans={self.plans_computed} "
+            f"hits={self.plan_hits} misses={self.plan_misses} "
+            f"pending={len(self.plan)} enabled={self.enabled}>"
+        )

@@ -63,6 +63,12 @@ class Scheduler(Server):
         **server_kwargs: Any,
     ):
         self._listen_addr = listen_addr
+        if placement is None and config.get("scheduler.jax.enabled"):
+            from distributed_tpu.scheduler.jax_placement import JaxPlacement
+
+            placement = JaxPlacement()
+        elif placement is False:
+            placement = None
         self.state = SchedulerState(
             validate=validate,
             transition_counter_max=transition_counter_max,

@@ -1823,6 +1823,16 @@ class SchedulerState:
         if client is not None:
             self.client_desires_keys(keys, client)
 
+        if self.placement is not None and hasattr(self.placement, "plan_graph"):
+            # one device call plans the whole incoming graph; consumed as
+            # per-task hints by decide_worker_non_rootish
+            try:
+                self.placement.plan_graph(
+                    self, {ts.key: ts for ts in touched}
+                )
+            except Exception:
+                logger.exception("placement planning failed")
+
         recommendations: dict[Key, str] = {}
         # seed transitions from the leaves up: released tasks that are
         # wanted (directly or transitively) go to waiting

@@ -140,6 +140,8 @@ class Nanny(Server):
                 f"{self.process.pid}"
             )
         if msg.get("op") != "started":
+            # disarm auto-restart: the caller decides what happens next
+            self.process.set_exit_callback(lambda code: None)
             raise RuntimeError(f"worker failed to start: {msg!r}")
         self._restart_attempts = 0
         self.worker_address = msg["address"]

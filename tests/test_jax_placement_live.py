@@ -1,0 +1,92 @@
+"""Live-cluster tests of the JAX placement co-processor: plans are
+computed at update_graph time and consumed by decide_worker, with exact
+fallback to the python oracle."""
+
+from __future__ import annotations
+
+import asyncio
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.scheduler.jax_placement import JaxPlacement
+
+from conftest import gen_test
+
+
+def inc(x):
+    return x + 1
+
+
+@gen_test(timeout=120)
+async def test_plan_consumed_and_results_correct():
+    placement = JaxPlacement(min_batch=4)
+    async with LocalCluster(
+        n_workers=2,
+        scheduler_kwargs={"validate": True, "placement": placement},
+        worker_kwargs={"validate": True},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            # one update-graph carrying a whole batch of chains: large
+            # enough to trigger device planning; distinct key prefixes
+            # keep the groups non-rootish so decide_worker_non_rootish
+            # consults the plan
+            from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+
+            g = Graph()
+            keys = []
+            for i in range(6):
+                g.tasks[f"src{i}-x"] = TaskSpec(inc, (i,))
+                g.tasks[f"out{i}-x"] = TaskSpec(inc, (TaskRef(f"src{i}-x"),))
+                keys.append(f"out{i}-x")
+            futs = c.compute_graph(g, keys)
+            results = await asyncio.wait_for(
+                c.gather([futs[k] for k in keys]), 60
+            )
+            assert results == [i + 2 for i in range(6)]
+            assert placement.plans_computed >= 1
+            assert placement.plan_hits > 0
+
+
+@gen_test(timeout=120)
+async def test_plan_fallback_when_worker_dies():
+    placement = JaxPlacement(min_batch=4)
+    async with LocalCluster(
+        n_workers=2,
+        scheduler_kwargs={"validate": True, "placement": placement},
+        worker_kwargs={"validate": True},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+
+            g = Graph()
+            keys = []
+            for i in range(4):
+                g.tasks[f"fbsrc{i}-x"] = TaskSpec(inc, (i,))
+                g.tasks[f"fbout{i}-x"] = TaskSpec(inc, (TaskRef(f"fbsrc{i}-x"),))
+                keys.append(f"fbout{i}-x")
+            futs = c.compute_graph(g, keys)
+            assert await asyncio.wait_for(
+                c.gather([futs[k] for k in keys]), 60
+            ) == [i + 2 for i in range(4)]
+            # drop a worker: its plan entries must be purged, new work runs
+            victim = cluster.workers[0]
+            await victim.close(report=False)
+            cluster.workers = cluster.workers[1:]
+            assert all(
+                addr != victim.address for addr in placement.plan.values()
+            )
+            futs2 = c.map(inc, range(8), pure=False)
+            assert await asyncio.wait_for(c.gather(futs2), 60) == list(
+                range(1, 9)
+            )
+
+
+@gen_test()
+async def test_placement_disabled_by_flag():
+    async with LocalCluster(
+        n_workers=1,
+        scheduler_kwargs={"validate": True, "placement": False},
+    ) as cluster:
+        assert cluster.scheduler.state.placement is None
+        async with Client(cluster.scheduler_address) as c:
+            assert await c.submit(inc, 1).result() == 2
