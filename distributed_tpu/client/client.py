@@ -174,6 +174,7 @@ class Client:
         self._timeout = timeout
         self._handle_report_task: asyncio.Task | None = None
         self._pubsub_subs: dict[str, list] = {}
+        self._event_handlers: dict[str, list] = {}
         self._worker_rpcs: dict[str, Any] = {}
         self._generation = 0
         self._loop_runner: LoopRunner | None = None
@@ -276,6 +277,14 @@ class Client:
                     elif op == "pubsub-msg":
                         for sub in self._pubsub_subs.get(msg.get("name"), ()):
                             sub._put(msg.get("msg"))
+                    elif op == "event":
+                        for handler in self._event_handlers.get(
+                            msg.get("topic"), ()
+                        ):
+                            try:
+                                handler(msg.get("msg"))
+                            except Exception:
+                                logger.exception("event handler failed")
                     elif op in ("stream-closed", "close", "restart"):
                         if op == "restart":
                             for st in self.futures.values():
@@ -350,6 +359,7 @@ class Client:
         resources: dict | None = None,
         retries: int | None = None,
         actors: Any = False,
+        annotations_by_key: dict[Key, dict] | None = None,
     ) -> dict[Key, Future]:
         """Ship a graph, returning futures for ``keys``
         (reference client.py:3098)."""
@@ -357,7 +367,7 @@ class Client:
             k: sorted(spec.dependencies()) if isinstance(spec, TaskSpec) else []
             for k, spec in tasks.items()
         }
-        annotations: dict[Key, dict] = {}
+        annotations: dict[Key, dict] = dict(annotations_by_key or {})
         ann: dict[str, Any] = {}
         if workers is not None:
             ann["workers"] = workers
@@ -368,7 +378,7 @@ class Client:
         if retries:
             ann["retries"] = retries
         if ann:
-            annotations = {k: ann for k in tasks}
+            annotations = {k: {**ann, **annotations.get(k, {})} for k in tasks}
         futures: dict[Key, Future] = {}
         for key in keys:
             if key not in self.futures:
@@ -645,6 +655,44 @@ class Client:
         await self.scheduler.restart()
         for st in self.futures.values():
             st.cancel()
+
+    # ------------------------------------------------------- observability
+
+    def log_event(self, topic: str, msg: Any) -> None:
+        """Record a structured event on the scheduler (reference
+        client.py log_event)."""
+        self.batched_stream.send(
+            {"op": "log-event-client", "topic": topic, "msg": msg,
+             "client": self.id}
+        )
+
+    async def get_events(self, topic: str | None = None) -> Any:
+        assert self.scheduler is not None
+        return await self.scheduler.events(topic=topic)
+
+    def subscribe_topic(self, topic: str, handler: Callable) -> None:
+        """Call ``handler(msg)`` for every event on ``topic``
+        (reference client.py:4503)."""
+        self._event_handlers.setdefault(topic, []).append(handler)
+        self.batched_stream.send(
+            {"op": "subscribe-topic", "topic": topic, "client": self.id}
+        )
+
+    def unsubscribe_topic(self, topic: str) -> None:
+        self._event_handlers.pop(topic, None)
+        self.batched_stream.send(
+            {"op": "unsubscribe-topic", "topic": topic, "client": self.id}
+        )
+
+    async def get_task_stream(self, start: float | None = None,
+                              count: int | None = None) -> list:
+        assert self.scheduler is not None
+        return await self.scheduler.get_task_stream(start=start, count=count)
+
+    async def profile(self, workers: list[str] | None = None,
+                      start: float | None = None) -> dict:
+        assert self.scheduler is not None
+        return await self.scheduler.get_profile(workers=workers, start=start)
 
     async def publish_dataset(self, name: str, data: Any,
                               override: bool = False) -> None:

@@ -99,7 +99,12 @@ class SpecCluster(Cluster):
                 await w.close()
 
             if to_close:
-                await asyncio.gather(*(_close_one(n) for n in to_close))
+                results = await asyncio.gather(
+                    *(_close_one(n) for n in to_close), return_exceptions=True
+                )
+                for r in results:
+                    if isinstance(r, BaseException):
+                        logger.warning("worker close failed: %r", r)
 
             # start workers in the spec but not yet live — concurrently,
             # so scale(N) pays ~one worker's startup latency
@@ -116,7 +121,16 @@ class SpecCluster(Cluster):
                 if n not in self.workers
             ]
             if pending:
-                await asyncio.gather(*(_start_one(n, s) for n, s in pending))
+                # return_exceptions: let every sibling settle (and register
+                # in self.workers) before re-raising the first failure, so
+                # close() sees a complete view and orphans nothing
+                results = await asyncio.gather(
+                    *(_start_one(n, s) for n, s in pending),
+                    return_exceptions=True,
+                )
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
 
     def _new_worker_name(self) -> str:
         while True:

@@ -1,0 +1,135 @@
+"""Statistical profiler (reference profile.py).
+
+A daemon thread samples the stack of every worker-executor thread every
+``interval`` (10 ms default, reference distributed.yaml:104-108) and
+aggregates frames into a call-tree dict; trees merge across cycles and
+across workers (``merge``, reference profile.py:219).  Exposed via
+``Worker.get_profile`` / ``Scheduler.get_profile`` RPCs.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from collections import deque
+from typing import Any
+
+from distributed_tpu import config
+from distributed_tpu.utils.misc import time
+
+
+def create() -> dict:
+    return {"count": 0, "children": {}, "identifier": "root", "description": ""}
+
+
+def _frame_identifier(frame) -> str:
+    co = frame.f_code
+    return f"{co.co_name};{co.co_filename};{frame.f_lineno}"
+
+
+def process(frame, state: dict, *, stop: str | None = None) -> None:
+    """Add one stack sample to the call tree (reference profile.py:128)."""
+    frames = []
+    while frame is not None:
+        if stop is not None and frame.f_code.co_filename.endswith(stop):
+            break
+        frames.append(frame)
+        frame = frame.f_back
+    frames.reverse()
+    state["count"] += 1
+    node = state
+    for fr in frames:
+        ident = _frame_identifier(fr)
+        child = node["children"].get(ident)
+        if child is None:
+            child = node["children"][ident] = {
+                "count": 0,
+                "children": {},
+                "identifier": ident,
+                "description": fr.f_code.co_name,
+            }
+        child["count"] += 1
+        node = child
+
+
+def merge(*trees: dict) -> dict:
+    """Merge call trees (reference profile.py:219)."""
+    out = create()
+    for tree in trees:
+        if not tree:
+            continue
+        out["count"] += tree.get("count", 0)
+        _merge_children(out["children"], tree.get("children", {}))
+    return out
+
+
+def _merge_children(dst: dict, src: dict) -> None:
+    for ident, node in src.items():
+        d = dst.get(ident)
+        if d is None:
+            dst[ident] = {
+                "count": node["count"],
+                "children": {},
+                "identifier": node["identifier"],
+                "description": node.get("description", ""),
+            }
+            _merge_children(dst[ident]["children"], node["children"])
+        else:
+            d["count"] += node["count"]
+            _merge_children(d["children"], node["children"])
+
+
+class Profiler:
+    """Background sampling thread (reference profile.py watch :371)."""
+
+    def __init__(self, thread_filter: str = "dtpu-worker-exec",
+                 interval: float | None = None, cycle: float | None = None,
+                 maxlen: int = 60):
+        prof_cfg = config.get("worker.profile")
+        self.interval = interval if interval is not None else config.parse_timedelta(
+            prof_cfg["interval"]
+        )
+        self.cycle = cycle if cycle is not None else config.parse_timedelta(
+            prof_cfg["cycle"]
+        )
+        self.thread_filter = thread_filter
+        self.current = create()
+        self.history: deque = deque(maxlen=maxlen)  # (timestamp, tree)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, name="dtpu-profiler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _watch(self) -> None:
+        last_cycle = time()
+        while not self._stop.wait(self.interval):
+            frames = sys._current_frames()
+            idents = {
+                t.ident: t.name
+                for t in threading.enumerate()
+                if self.thread_filter in (t.name or "")
+            }
+            with self._lock:
+                for ident in idents:
+                    frame = frames.get(ident)
+                    if frame is not None:
+                        process(frame, self.current)
+                if time() - last_cycle > self.cycle:
+                    self.history.append((time(), self.current))
+                    self.current = create()
+                    last_cycle = time()
+
+    def get_profile(self, start: float | None = None) -> dict:
+        with self._lock:
+            trees = [t for ts, t in self.history if start is None or ts >= start]
+            trees.append(self.current)
+            return merge(*trees)

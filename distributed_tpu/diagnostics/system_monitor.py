@@ -1,0 +1,105 @@
+"""SystemMonitor: periodic host metrics sampling (reference system_monitor.py:18).
+
+Samples cpu/memory/network/disk every 500 ms into fixed-length ring
+buffers; the latest sample ships with worker heartbeats and feeds the
+Prometheus collectors and ``/info`` routes.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Any
+
+from distributed_tpu.utils.misc import time
+
+logger = logging.getLogger("distributed_tpu.system_monitor")
+
+
+class SystemMonitor:
+    def __init__(self, maxlen: int = 7200):
+        self.maxlen = maxlen
+        self.quantities: dict[str, deque] = {
+            "cpu": deque(maxlen=maxlen),
+            "memory": deque(maxlen=maxlen),
+            "time": deque(maxlen=maxlen),
+            "host_net_io.read_bps": deque(maxlen=maxlen),
+            "host_net_io.write_bps": deque(maxlen=maxlen),
+            "host_disk_io.read_bps": deque(maxlen=maxlen),
+            "host_disk_io.write_bps": deque(maxlen=maxlen),
+        }
+        self.count = 0
+        self._last_time = time()
+        self._last_net = self._net_counters()
+        self._last_disk = self._disk_counters()
+        try:
+            import psutil
+
+            self._proc = psutil.Process()
+            self._proc.cpu_percent()  # prime the interval sampler
+        except Exception:
+            self._proc = None
+
+    @staticmethod
+    def _net_counters():
+        try:
+            import psutil
+
+            c = psutil.net_io_counters()
+            return (c.bytes_recv, c.bytes_sent)
+        except Exception:
+            return (0, 0)
+
+    @staticmethod
+    def _disk_counters():
+        try:
+            import psutil
+
+            c = psutil.disk_io_counters()
+            if c is None:
+                return (0, 0)
+            return (c.read_bytes, c.write_bytes)
+        except Exception:
+            return (0, 0)
+
+    def update(self) -> dict[str, Any]:
+        """Take one sample; returns it (reference system_monitor.py:141)."""
+        now = time()
+        dt = max(now - self._last_time, 1e-6)
+        self._last_time = now
+        cpu = mem = 0.0
+        if self._proc is not None:
+            try:
+                cpu = self._proc.cpu_percent()
+                mem = self._proc.memory_info().rss
+            except Exception:
+                pass
+        net = self._net_counters()
+        disk = self._disk_counters()
+        sample = {
+            "time": now,
+            "cpu": cpu,
+            "memory": mem,
+            "host_net_io.read_bps": (net[0] - self._last_net[0]) / dt,
+            "host_net_io.write_bps": (net[1] - self._last_net[1]) / dt,
+            "host_disk_io.read_bps": (disk[0] - self._last_disk[0]) / dt,
+            "host_disk_io.write_bps": (disk[1] - self._last_disk[1]) / dt,
+        }
+        self._last_net = net
+        self._last_disk = disk
+        for k, v in sample.items():
+            self.quantities[k].append(v)
+        self.count += 1
+        return sample
+
+    def recent(self) -> dict[str, Any]:
+        return {
+            k: (q[-1] if q else 0) for k, q in self.quantities.items()
+        }
+
+    def range_query(self, start: int = 0) -> dict[str, list]:
+        istart = max(0, start - (self.count - len(self.quantities["time"])))
+        return {
+            "count": self.count,
+            **{k: list(q)[istart:] for k, q in self.quantities.items()},
+        }

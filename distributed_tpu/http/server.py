@@ -1,0 +1,183 @@
+"""Minimal asyncio HTTP server for observability routes (reference http/).
+
+The reference mounts tornado route tables on every ServerNode
+(node.py, http/scheduler/*, http/worker/*); here a small asyncio
+handler serves the same surface without a web-framework dependency:
+
+- /health                    liveness probe (reference http/health.py:6)
+- /info                      identity JSON
+- /metrics                   Prometheus text exposition
+  (reference http/scheduler/prometheus/core.py, http/worker/prometheus/)
+- /json/counts.json          scheduler state counts (reference http/scheduler/json.py)
+- /sysmon                    SystemMonitor ring buffers
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Callable
+
+logger = logging.getLogger("distributed_tpu.http")
+
+
+class HTTPServer:
+    """Tiny HTTP/1.0 route server bound next to a Server's comm listener."""
+
+    def __init__(self, routes: dict[str, Callable[[], Any]], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.routes = routes
+        self.host = host
+        self.requested_port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "HTTPServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.requested_port
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 5)
+            parts = request_line.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            path = parts[1].split("?")[0]
+            # drain headers
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            handler = self.routes.get(path)
+            if handler is None:
+                body = b"not found"
+                status, ctype = "404 Not Found", "text/plain"
+            else:
+                try:
+                    result = handler()
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                    if isinstance(result, (dict, list)):
+                        body = json.dumps(result, default=str).encode()
+                        ctype = "application/json"
+                    elif isinstance(result, bytes):
+                        body = result
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        body = str(result).encode()
+                        ctype = "text/plain"
+                    status = "200 OK"
+                except Exception as e:
+                    logger.exception("http handler %s failed", path)
+                    body = f"error: {e}".encode()
+                    status, ctype = "500 Internal Server Error", "text/plain"
+            writer.write(
+                (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+# ----------------------------------------------------- prometheus helpers
+
+def prom_line(name: str, value: float, labels: dict | None = None,
+              help_: str | None = None, type_: str = "gauge") -> str:
+    out = []
+    if help_:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {type_}")
+    if labels:
+        lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        out.append(f"{name}{{{lab}}} {value}")
+    else:
+        out.append(f"{name} {value}")
+    return "\n".join(out)
+
+
+def scheduler_metrics(scheduler: Any) -> bytes:
+    """Prometheus exposition for the scheduler
+    (reference http/scheduler/prometheus/core.py)."""
+    s = scheduler.state
+    lines = []
+    by_state: dict[str, int] = {}
+    for ts in s.tasks.values():
+        by_state[ts.state] = by_state.get(ts.state, 0) + 1
+    lines.append("# HELP dtpu_scheduler_tasks Tasks by state")
+    lines.append("# TYPE dtpu_scheduler_tasks gauge")
+    for state, n in sorted(by_state.items()):
+        lines.append(prom_line("dtpu_scheduler_tasks", n, {"state": state}))
+    lines.append(
+        prom_line(
+            "dtpu_scheduler_workers", len(s.workers),
+            help_="Registered workers", type_="gauge",
+        )
+    )
+    lines.append(
+        prom_line(
+            "dtpu_scheduler_clients", len(s.clients),
+            help_="Connected clients", type_="gauge",
+        )
+    )
+    lines.append(
+        prom_line(
+            "dtpu_scheduler_total_occupancy", s.total_occupancy,
+            help_="Seconds of queued work", type_="gauge",
+        )
+    )
+    stealing = scheduler.extensions.get("stealing")
+    if stealing is not None:
+        lines.append(
+            prom_line(
+                "dtpu_stealing_moves_total", stealing.count,
+                help_="Confirmed task steals", type_="counter",
+            )
+        )
+    return ("\n".join(lines) + "\n").encode()
+
+
+def worker_metrics(worker: Any) -> bytes:
+    """Prometheus exposition for a worker (reference http/worker/prometheus/)."""
+    st = worker.state
+    lines = [
+        prom_line("dtpu_worker_tasks_executing", len(st.executing),
+                  help_="Currently executing", type_="gauge"),
+        prom_line("dtpu_worker_tasks_ready", len(st.ready)),
+        prom_line("dtpu_worker_tasks_stored", len(worker.data)),
+        prom_line("dtpu_worker_nbytes", st.nbytes_in_memory,
+                  help_="Managed memory bytes", type_="gauge"),
+        prom_line("dtpu_worker_transfers_incoming", st.transfer_incoming_count),
+    ]
+    data = worker.data
+    if hasattr(data, "spilled_count"):
+        lines.append(
+            prom_line("dtpu_worker_spill_count_total", data.spilled_count,
+                      type_="counter")
+        )
+        lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
+    return ("\n".join(lines) + "\n").encode()

@@ -60,8 +60,12 @@ class Scheduler(Server):
         extensions: dict[str, Any] | None = None,
         worker_ttl: float | None = None,
         idle_timeout: float | None = None,
+        http_port: int | None = 0,
         **server_kwargs: Any,
     ):
+        self._http_port = http_port
+        self.http_server = None
+        self.monitor = None
         self._listen_addr = listen_addr
         if placement is None and config.get("scheduler.jax.enabled"):
             from distributed_tpu.scheduler.jax_placement import JaxPlacement
@@ -143,12 +147,42 @@ class Scheduler(Server):
         for name, ext_cls in extensions.items():
             self.extensions[name] = ext_cls(self)
         self.state.extensions = self.extensions
+        from distributed_tpu.diagnostics.task_stream import TaskStreamPlugin
+
+        self.task_stream = TaskStreamPlugin(self)
+        self._topic_subscribers: dict[str, set[str]] = {}
+        self.state.events_subscriber_hook = self._fan_out_event
+        self.handlers["get_task_stream"] = self.get_task_stream
+        self.handlers["get_profile"] = self.get_profile
+        self.stream_handlers["subscribe-topic"] = self.subscribe_topic
+        self.stream_handlers["unsubscribe-topic"] = self.unsubscribe_topic
+        self.stream_handlers["log-event-client"] = self.handle_client_log_event
 
     # ----------------------------------------------------------- lifecycle
 
     async def start_unsafe(self) -> "Scheduler":
         addr = self._listen_addr or "tcp://127.0.0.1:0"
         await self.listen(addr)
+        # observability: SystemMonitor sampling + HTTP routes
+        from distributed_tpu.diagnostics.system_monitor import SystemMonitor
+        from distributed_tpu.http.server import HTTPServer, scheduler_metrics
+
+        self.monitor = SystemMonitor()
+        self.periodic_callbacks["monitor"] = PeriodicCallback(
+            self.monitor.update, 0.5
+        )
+        if self._http_port is not None:
+            self.http_server = HTTPServer(
+                {
+                    "/health": lambda: "ok",
+                    "/info": self.identity,
+                    "/metrics": lambda: scheduler_metrics(self),
+                    "/json/counts.json": self._counts_json,
+                    "/sysmon": lambda: self.monitor.range_query(),
+                },
+                port=self._http_port,
+            )
+            await self.http_server.start()
         if self.worker_ttl:
             self.periodic_callbacks["worker-ttl"] = PeriodicCallback(
                 self.check_worker_ttl, max(self.worker_ttl / 4, 0.25)
@@ -187,6 +221,8 @@ class Scheduler(Server):
             await bs.close(timeout=0.5)
         for client, bs in list(self.client_comms.items()):
             await bs.close(timeout=0.5)
+        if self.http_server is not None:
+            await self.http_server.stop()
         await super().close()
 
     # ------------------------------------------------------------ messaging
@@ -370,6 +406,8 @@ class Scheduler(Server):
             await self.handle_stream(comm, extra={"client": client})
         finally:
             self.client_comms.pop(client, None)
+            for subs in self._topic_subscribers.values():
+                subs.discard(client)
             stimulus_id = seq_name("remove-client")
             client_msgs, worker_msgs = self.state.remove_client_state(
                 client, stimulus_id
@@ -582,7 +620,7 @@ class Scheduler(Server):
 
     def handle_worker_log_event(self, topic: Any = None, msg: Any = None,
                                 worker: str = "", **kw: Any) -> None:
-        self.state.log_event(topic or "all", {"worker": worker, "msg": msg})
+        self.log_event(topic or "all", {"worker": worker, "msg": msg})
 
     def handle_worker_status_change(self, status: str = "", worker: str = "",
                                     stimulus_id: str = "", **kw: Any) -> None:
@@ -857,8 +895,63 @@ class Scheduler(Server):
     async def get_missing_workers(self) -> list:
         return []
 
+    def _counts_json(self) -> dict:
+        s = self.state
+        by_state: dict[str, int] = {}
+        for ts in s.tasks.values():
+            by_state[ts.state] = by_state.get(ts.state, 0) + 1
+        return {
+            "tasks": len(s.tasks),
+            "states": by_state,
+            "workers": len(s.workers),
+            "clients": len(s.clients),
+            "queued": len(s.queued),
+            "unrunnable": len(s.unrunnable),
+        }
+
     async def log_event_handler(self, topic: Any = None, msg: Any = None) -> None:
-        self.state.log_event(topic or "all", msg)
+        self.log_event(topic or "all", msg)
+
+    def log_event(self, topic: Any, msg: Any) -> None:
+        """Record + fan out to subscribed clients (reference scheduler.py:8244)."""
+        self.state.log_event(topic, msg)
+
+    def _fan_out_event(self, topics: list, msg: Any) -> None:
+        for t in topics:
+            for client in self._topic_subscribers.get(t, ()):
+                self.report(
+                    {"op": "event", "topic": t, "msg": msg}, client=client
+                )
+
+    def subscribe_topic(self, topic: str = "", client: str = "", **kw: Any) -> None:
+        self._topic_subscribers.setdefault(topic, set()).add(client)
+
+    def unsubscribe_topic(self, topic: str = "", client: str = "", **kw: Any) -> None:
+        self._topic_subscribers.get(topic, set()).discard(client)
+
+    def handle_client_log_event(self, topic: Any = None, msg: Any = None,
+                                client: str = "", **kw: Any) -> None:
+        self.log_event(topic or "all", msg)
+
+    async def get_task_stream(self, start: float | None = None,
+                              count: int | None = None) -> list:
+        return self.task_stream.collect(start=start, count=count)
+
+    async def get_profile(self, workers: list[str] | None = None,
+                          start: float | None = None) -> Any:
+        """Merged worker profiles (reference scheduler.py:7991)."""
+        from distributed_tpu.diagnostics.profile import merge
+        from distributed_tpu.protocol.serialize import unwrap
+
+        resp = await self.broadcast(
+            msg={"op": "profile", "start": start}, workers=workers
+        )
+        trees = []
+        for v in resp.values():
+            v = unwrap(v)
+            if isinstance(v, dict) and "count" in v:
+                trees.append(v)
+        return merge(*trees)
 
     async def get_events_handler(self, topic: str | None = None) -> Any:
         if topic is not None:

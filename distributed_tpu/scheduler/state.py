@@ -438,6 +438,7 @@ class SchedulerState:
         self.plugins: dict[str, Any] = {}
         self.placement = placement  # JAX co-processor hook (ops/placement.py)
         self.extensions: dict[str, Any] = {}
+        self.events_subscriber_hook: Callable | None = None
         self.events: defaultdict[str, deque] = defaultdict(
             lambda: deque(maxlen=config.get("scheduler.events-log-length"))
         )
@@ -1456,13 +1457,23 @@ class SchedulerState:
     # ------------------------------------------------------- events
 
     def log_event(self, topic: str | Iterable[str], msg: Any) -> None:
-        """Ring-buffered structured events (reference scheduler.py:8244)."""
+        """Ring-buffered structured events (reference scheduler.py:8244).
+
+        Every call — internal state-machine events included — also reaches
+        live topic subscribers via ``events_subscriber_hook`` (set by the
+        Scheduler server)."""
         if isinstance(topic, str):
             topic = [topic]
+        topic = list(topic)
         stamp = time()
         for t in topic:
             self.events[t].append((stamp, msg))
             self.event_counts[t] += 1
+        if self.events_subscriber_hook is not None:
+            try:
+                self.events_subscriber_hook(topic, msg)
+            except Exception:
+                logger.exception("event subscriber hook failed")
 
     # ----------------------------------------------------- stimuli (pure)
 

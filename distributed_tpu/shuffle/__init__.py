@@ -1,0 +1,14 @@
+from distributed_tpu.shuffle.api import p2p_rechunk, p2p_shuffle
+from distributed_tpu.shuffle.core import (
+    ShuffleRun,
+    ShuffleSpec,
+    ShuffleWorkerExtension,
+)
+
+__all__ = [
+    "p2p_shuffle",
+    "p2p_rechunk",
+    "ShuffleRun",
+    "ShuffleSpec",
+    "ShuffleWorkerExtension",
+]

@@ -1,0 +1,202 @@
+"""Shuffle graph builder + task bodies (reference shuffle/_shuffle.py,
+_rechunk.py graph shapes).
+
+``p2p_shuffle`` repartitions a list of record-partition futures into
+``npartitions_out`` hash partitions; ``p2p_rechunk`` re-tiles a 1-D
+chunked array.  Both build the O(N+M) transfer/barrier/unpack graph whose
+data plane is the direct worker->worker push engine in ``shuffle.core``.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable
+
+from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+from distributed_tpu.shuffle.core import (
+    ShuffleSpec,
+    concat_records,
+    make_keyed_splitter,
+    split_records_by_hash,
+)
+
+
+# ------------------------------------------------------------ task bodies
+# (async: they run on the worker event loop and reach the engine through
+# the execution context, reference shuffle/_shuffle.py shuffle_transfer)
+
+async def shuffle_transfer(data: Any, spec_msg: dict, partition_id: int,
+                           key: Callable | None = None) -> int:
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = worker.shuffle.get_or_create(ShuffleSpec.from_msg(spec_msg))
+    splitter = make_keyed_splitter(key) if key is not None else split_records_by_hash
+    await run.add_partition(data, partition_id, splitter)
+    return partition_id
+
+
+async def shuffle_barrier(spec_msg: dict, *transfer_results: int) -> int:
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = worker.shuffle.get_or_create(ShuffleSpec.from_msg(spec_msg))
+    await run.barrier()
+    return run.run_id
+
+
+async def shuffle_unpack(spec_msg: dict, partition_id: int,
+                         barrier_result: int) -> Any:
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = worker.shuffle.get_or_create(ShuffleSpec.from_msg(spec_msg))
+    return await run.get_output_partition(partition_id, concat_records)
+
+
+# ------------------------------------------------------- rechunk variants
+
+async def rechunk_transfer(chunk: Any, spec_msg: dict, partition_id: int,
+                           old_offset: int, new_bounds: tuple) -> int:
+    """Route slices of a 1-D chunk to their output-chunk owners
+    (reference shuffle/_rechunk.py rechunk_transfer)."""
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = worker.shuffle.get_or_create(ShuffleSpec.from_msg(spec_msg))
+
+    def splitter(data: Any, npartitions: int) -> dict[int, Any]:
+        out: dict[int, Any] = {}
+        n = len(data)
+        for j in range(npartitions):
+            lo, hi = new_bounds[j], new_bounds[j + 1]
+            s = max(lo - old_offset, 0)
+            e = min(hi - old_offset, n)
+            if s < e:
+                # tag with the absolute offset so assembly can sort
+                out[j] = (old_offset + s, data[s:e])
+        return out
+
+    await run.add_partition(chunk, partition_id, splitter)
+    return partition_id
+
+
+async def rechunk_unpack(spec_msg: dict, partition_id: int,
+                         barrier_result: int) -> Any:
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = worker.shuffle.get_or_create(ShuffleSpec.from_msg(spec_msg))
+
+    def assembler(shards: list) -> Any:
+        import numpy as np
+
+        pieces = sorted(shards, key=lambda t: t[0])
+        arrays = [p[1] for p in pieces]
+        if not arrays:
+            return np.empty(0)
+        if isinstance(arrays[0], np.ndarray):
+            return np.concatenate(arrays)
+        out: list = []
+        for a in arrays:
+            out.extend(a)
+        return out
+
+    return await run.get_output_partition(partition_id, assembler)
+
+
+# --------------------------------------------------------- graph builders
+
+async def _worker_for(client: Any, npartitions_out: int) -> dict[int, str]:
+    info = await client.scheduler_info()
+    addrs = sorted(info["workers"])
+    if not addrs:
+        raise RuntimeError("no workers available for shuffle")
+    return {j: addrs[j % len(addrs)] for j in range(npartitions_out)}
+
+
+async def p2p_shuffle(
+    client: Any,
+    inputs: list,
+    npartitions_out: int | None = None,
+    key: Callable | None = None,
+) -> list:
+    """Hash-shuffle record partitions (futures) into npartitions_out
+    partitions; returns output futures."""
+    npartitions_out = npartitions_out or len(inputs)
+    shuffle_id = f"shuffle-{uuid.uuid4().hex[:12]}"
+    worker_for = await _worker_for(client, npartitions_out)
+    spec = ShuffleSpec(shuffle_id, 1, npartitions_out, worker_for)
+    msg = spec.to_msg()
+
+    g = Graph()
+    transfer_keys = []
+    for i, fut in enumerate(inputs):
+        k = f"{shuffle_id}-transfer-{i}"
+        g.tasks[k] = TaskSpec(
+            shuffle_transfer, (TaskRef(fut.key), msg, i, key)
+        )
+        transfer_keys.append(k)
+    barrier_key = f"{shuffle_id}-barrier"
+    g.tasks[barrier_key] = TaskSpec(
+        shuffle_barrier, (msg, *[TaskRef(k) for k in transfer_keys]),
+    )
+    unpack_keys = []
+    annotations = {}
+    for j in range(npartitions_out):
+        k = f"{shuffle_id}-unpack-{j}"
+        g.tasks[k] = TaskSpec(shuffle_unpack, (msg, j, TaskRef(barrier_key)))
+        unpack_keys.append(k)
+        annotations[k] = {"workers": [worker_for[j]]}
+
+    # inputs must exist as graph nodes for dependency wiring
+    futs = client._graph_to_futures(
+        dict(g.tasks), unpack_keys, annotations_by_key=annotations,
+    )
+    return [futs[k] for k in unpack_keys]
+
+
+async def p2p_rechunk(client: Any, chunks: list, chunk_sizes: list[int],
+                      new_chunk_sizes: list[int]) -> list:
+    """Re-tile a 1-D chunked array (futures of chunks) onto new chunk
+    boundaries (reference shuffle/_rechunk.py)."""
+    assert sum(chunk_sizes) == sum(new_chunk_sizes)
+    npartitions_out = len(new_chunk_sizes)
+    shuffle_id = f"rechunk-{uuid.uuid4().hex[:12]}"
+    worker_for = await _worker_for(client, npartitions_out)
+    spec = ShuffleSpec(shuffle_id, 1, npartitions_out, worker_for)
+    msg = spec.to_msg()
+
+    old_offsets = [0]
+    for s in chunk_sizes:
+        old_offsets.append(old_offsets[-1] + s)
+    new_bounds = [0]
+    for s in new_chunk_sizes:
+        new_bounds.append(new_bounds[-1] + s)
+    new_bounds_t = tuple(new_bounds)
+
+    g = Graph()
+    transfer_keys = []
+    for i, fut in enumerate(chunks):
+        k = f"{shuffle_id}-transfer-{i}"
+        g.tasks[k] = TaskSpec(
+            rechunk_transfer,
+            (TaskRef(fut.key), msg, i, old_offsets[i], new_bounds_t),
+        )
+        transfer_keys.append(k)
+    barrier_key = f"{shuffle_id}-barrier"
+    g.tasks[barrier_key] = TaskSpec(
+        shuffle_barrier, (msg, *[TaskRef(k) for k in transfer_keys]),
+    )
+    unpack_keys = []
+    annotations = {}
+    for j in range(npartitions_out):
+        k = f"{shuffle_id}-unpack-{j}"
+        g.tasks[k] = TaskSpec(rechunk_unpack, (msg, j, TaskRef(barrier_key)))
+        unpack_keys.append(k)
+        annotations[k] = {"workers": [worker_for[j]]}
+
+    futs = client._graph_to_futures(
+        dict(g.tasks), unpack_keys, annotations_by_key=annotations,
+    )
+    return [futs[k] for k in unpack_keys]

@@ -1,0 +1,292 @@
+"""P2P shuffle engine (reference shuffle/_core.py, _worker_plugin.py).
+
+All-to-all repartitioning that bypasses the task-graph data model:
+N input partitions -> shards pushed directly worker->worker -> M output
+partitions, at O(N+M) scheduler tasks instead of O(N*M)
+(reference shuffle/_core.py:62-380).
+
+Graph shape (built by ``distributed_tpu.shuffle.api``):
+
+    transfer(i):  split input partition i by output -> push shards to the
+                  owner of each output partition (direct RPC)
+    barrier:      after all transfers -> broadcast inputs_done to every
+                  participant
+    unpack(j):    restricted to worker_for[j] -> await inputs_done,
+                  assemble output partition j from received shards
+
+Runs are fenced by ``run_id`` epochs like the reference
+(shuffle/_worker_plugin.py:36): stale shards from a previous attempt of
+the same shuffle id are rejected, enabling restart after worker loss.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+from typing import Any, Callable
+
+from distributed_tpu.exceptions import CommClosedError
+from distributed_tpu.protocol.serialize import Serialize, unwrap
+
+logger = logging.getLogger("distributed_tpu.shuffle")
+
+
+class ShuffleClosedError(RuntimeError):
+    pass
+
+
+class ShuffleSpec:
+    """Declarative description of one shuffle (reference shuffle/_core.py:421)."""
+
+    __slots__ = ("id", "run_id", "npartitions_out", "worker_for")
+
+    def __init__(self, id: str, run_id: int, npartitions_out: int,
+                 worker_for: dict[int, str]):
+        self.id = id
+        self.run_id = run_id
+        self.npartitions_out = npartitions_out
+        self.worker_for = dict(worker_for)
+
+    @property
+    def participants(self) -> list[str]:
+        return sorted(set(self.worker_for.values()))
+
+    def to_msg(self) -> dict:
+        return {
+            "id": self.id,
+            "run_id": self.run_id,
+            "npartitions_out": self.npartitions_out,
+            "worker_for": {str(k): v for k, v in self.worker_for.items()},
+        }
+
+    @classmethod
+    def from_msg(cls, msg: dict) -> "ShuffleSpec":
+        return cls(
+            msg["id"], msg["run_id"], msg["npartitions_out"],
+            {int(k): v for k, v in msg["worker_for"].items()},
+        )
+
+
+class ShuffleRun:
+    """Per-worker engine for one (id, run_id) (reference shuffle/_core.py:62)."""
+
+    def __init__(self, spec: ShuffleSpec, worker: Any):
+        self.spec = spec
+        self.worker = worker
+        # output partition -> {source tag: shard}; keyed by source so a
+        # recomputed transfer re-pushing its shards is idempotent
+        self.shards: defaultdict[int, dict[int, Any]] = defaultdict(dict)
+        self.inputs_done = asyncio.Event()
+        self.closed = False
+        self.bytes_received = 0
+        self.transfers_done: set[int] = set()
+        self.local_outputs_left = sum(
+            1 for addr in spec.worker_for.values() if addr == worker.address
+        )
+
+    @property
+    def id(self) -> str:
+        return self.spec.id
+
+    @property
+    def run_id(self) -> int:
+        return self.spec.run_id
+
+    # ---------------------------------------------------------- data plane
+
+    async def add_partition(self, data: Any, partition_id: int,
+                            splitter: Callable) -> int:
+        """Split one input partition and push shards to their owners
+        (reference shuffle/_core.py:331)."""
+        if self.closed:
+            raise ShuffleClosedError(self.id)
+        out_shards = splitter(data, self.spec.npartitions_out)
+        by_worker: defaultdict[str, dict[int, list]] = defaultdict(dict)
+        for j, shard in out_shards.items():
+            addr = self.spec.worker_for[j % self.spec.npartitions_out]
+            by_worker[addr].setdefault(j, []).append((partition_id, shard))
+
+        async def send(addr: str, shards: dict):
+            if addr == self.worker.address:
+                self.receive(shards)
+                return
+            # the spec rides along: the receiver may not have seen this
+            # shuffle yet (it owns outputs but runs no transfer tasks)
+            resp = await self.worker.rpc(addr).shuffle_receive(
+                id=self.id, run_id=self.run_id,
+                spec=self.spec.to_msg(),
+                shards=Serialize(shards),
+            )
+            if resp.get("status") != "OK":
+                raise RuntimeError(
+                    f"shuffle_receive failed on {addr}: {resp!r}"
+                )
+
+        await asyncio.gather(*(send(a, s) for a, s in by_worker.items()))
+        self.transfers_done.add(partition_id)
+        return partition_id
+
+    def receive(self, shards: dict) -> None:
+        """Accept shards pushed by a peer (reference shuffle/_core.py:260)."""
+        if self.closed:
+            raise ShuffleClosedError(self.id)
+        for j, tagged in shards.items():
+            bucket = self.shards[int(j)]
+            for tag, shard in tagged:
+                bucket[tag] = shard
+
+    async def barrier(self) -> None:
+        """All inputs transferred: notify every participant
+        (reference shuffle/_core.py:190)."""
+        async def notify(addr: str):
+            if addr == self.worker.address:
+                self.inputs_done.set()
+                return
+            try:
+                await self.worker.rpc(addr).shuffle_inputs_done(
+                    id=self.id, run_id=self.run_id, spec=self.spec.to_msg()
+                )
+            except (CommClosedError, OSError) as e:
+                raise RuntimeError(
+                    f"barrier could not reach {addr}"
+                ) from e
+
+        await asyncio.gather(*(notify(a) for a in self.spec.participants))
+
+    async def get_output_partition(self, j: int, assembler: Callable,
+                                   timeout: float = 30.0) -> Any:
+        """Assemble output partition j (reference shuffle/_core.py:353)."""
+        await asyncio.wait_for(self.inputs_done.wait(), timeout)
+        bucket = self.shards.pop(j, {})
+        self.local_outputs_left -= 1
+        if self.local_outputs_left <= 0:
+            # every local output served: schedule forgetting this run so
+            # long-lived workers don't accumulate one run per shuffle id
+            # (delayed: a rescheduled unpack may still re-request briefly)
+            self.worker.shuffle.schedule_cleanup(self.id, self.run_id)
+        return assembler([bucket[tag] for tag in sorted(bucket)])
+
+    def close(self) -> None:
+        self.closed = True
+        self.shards.clear()
+
+
+class ShuffleWorkerExtension:
+    """Caches active runs by (id, run_id); fences stale epochs
+    (reference shuffle/_worker_plugin.py:36)."""
+
+    def __init__(self, worker: Any):
+        self.worker = worker
+        self.runs: dict[str, ShuffleRun] = {}  # id -> newest run
+        worker.handlers["shuffle_receive"] = self.shuffle_receive
+        worker.handlers["shuffle_inputs_done"] = self.shuffle_inputs_done
+
+    def get_or_create(self, spec: ShuffleSpec) -> ShuffleRun:
+        run = self.runs.get(spec.id)
+        if run is not None:
+            if run.run_id > spec.run_id:
+                raise ShuffleClosedError(
+                    f"{spec.id} run {spec.run_id} superseded by {run.run_id}"
+                )
+            if run.run_id == spec.run_id:
+                return run
+            run.close()  # stale epoch: replace
+        run = self.runs[spec.id] = ShuffleRun(spec, self.worker)
+        return run
+
+    def _get_checked(self, id: str, run_id: int) -> ShuffleRun | None:
+        run = self.runs.get(id)
+        if run is None or run.run_id != run_id:
+            return None
+        return run
+
+    # ------------------------------------------------------------ handlers
+
+    async def shuffle_receive(self, id: str = "", run_id: int = 0,
+                              spec: dict | None = None,
+                              shards: Any = None) -> dict:
+        run = self.runs.get(id)
+        if run is not None and run.run_id > run_id:
+            return {"status": "stale", "id": id, "run_id": run_id}
+        if run is None or run.run_id < run_id:
+            # first contact for this (id, run_id): build the run from the
+            # spec riding on the message
+            if spec is None:
+                return {"status": "unknown-run", "id": id, "run_id": run_id}
+            run = self.get_or_create(ShuffleSpec.from_msg(spec))
+        run.receive(unwrap(shards))
+        return {"status": "OK"}
+
+    async def shuffle_inputs_done(self, id: str = "", run_id: int = 0,
+                                  spec: dict | None = None) -> dict:
+        run = self._get_checked(id, run_id)
+        if run is None:
+            if spec is None:
+                return {"status": "stale"}
+            run = self.get_or_create(ShuffleSpec.from_msg(spec))
+        run.inputs_done.set()
+        return {"status": "OK"}
+
+    def schedule_cleanup(self, id: str, run_id: int, delay: float = 30.0) -> None:
+        """Forget a completed run after a grace period."""
+
+        async def _cleanup() -> None:
+            run = self.runs.get(id)
+            if run is not None and run.run_id == run_id:
+                run.close()
+                del self.runs[id]
+
+        self.worker._ongoing_background_tasks.call_later(delay, _cleanup)
+
+    def close(self) -> None:
+        for run in self.runs.values():
+            run.close()
+        self.runs.clear()
+
+
+# ------------------------------------------------------------ splitters
+
+def stable_hash(x: Any) -> int:
+    """Process-independent hash: builtin hash() is randomized per
+    interpreter for str/bytes, which would route equal keys hashed on
+    different workers to different partitions."""
+    import hashlib
+
+    if isinstance(x, bool):
+        x = repr(x).encode()
+    elif isinstance(x, int):
+        return x
+    if isinstance(x, str):
+        x = x.encode()
+    elif not isinstance(x, bytes):
+        x = repr(x).encode()
+    return int.from_bytes(
+        hashlib.blake2b(x, digest_size=8).digest(), "big"
+    )
+
+
+def split_records_by_hash(data: Any, npartitions: int) -> dict[int, list]:
+    """Generic record splitter: hash each record (or its key for
+    (key, value) pairs is the caller's concern) into an output partition."""
+    out: defaultdict[int, list] = defaultdict(list)
+    for rec in data:
+        out[stable_hash(rec) % npartitions].append(rec)
+    return dict(out)
+
+
+def make_keyed_splitter(key: Callable) -> Callable:
+    def splitter(data: Any, npartitions: int) -> dict[int, list]:
+        out: defaultdict[int, list] = defaultdict(list)
+        for rec in data:
+            out[stable_hash(key(rec)) % npartitions].append(rec)
+        return dict(out)
+
+    return splitter
+
+
+def concat_records(shards: list) -> list:
+    out: list = []
+    for shard in shards:
+        out.extend(shard)
+    return out

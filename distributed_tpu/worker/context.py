@@ -1,0 +1,45 @@
+"""Task execution context: ``get_worker()`` from inside a task
+(reference worker.py get_worker / thread_state).
+
+The worker sets a thread-local before invoking user code in its executor
+(threads are per-worker pools, so the binding is exact even with several
+in-process workers), and a contextvar for tasks executed as coroutines on
+the event loop.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from distributed_tpu.worker.server import Worker
+
+_thread_state = threading.local()
+_async_worker: contextvars.ContextVar = contextvars.ContextVar(
+    "dtpu_worker", default=None
+)
+
+
+def set_thread_worker(worker: "Worker") -> None:
+    _thread_state.worker = worker
+
+
+def set_async_worker(worker: "Worker"):
+    return _async_worker.set(worker)
+
+
+def reset_async_worker(token) -> None:
+    _async_worker.reset(token)
+
+
+def get_worker() -> "Worker":
+    """The Worker hosting the currently-executing task."""
+    worker = getattr(_thread_state, "worker", None)
+    if worker is not None:
+        return worker
+    worker = _async_worker.get()
+    if worker is not None:
+        return worker
+    raise ValueError("no worker found in this thread/task context")

@@ -77,8 +77,12 @@ class Worker(Server):
         validate: bool | None = None,
         heartbeat_interval: float | None = None,
         listen_addr: str | None = None,
+        http_port: int | None = 0,
         **server_kwargs: Any,
     ):
+        self._http_port = http_port
+        self.http_server = None
+        self.monitor = None
         self.scheduler_addr = scheduler_addr
         self.nthreads = nthreads or 1
         self.memory_limit = memory_limit
@@ -121,6 +125,7 @@ class Worker(Server):
             "free_keys": self.handle_free_keys_rpc,
             "actor_execute": self.actor_execute,
             "actor_attribute": self.actor_attribute,
+            "profile": self.get_profile,
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
@@ -141,6 +146,14 @@ class Worker(Server):
             **server_kwargs,
         )
         self.name = name if name is not None else self.id
+        from distributed_tpu.shuffle.core import ShuffleWorkerExtension
+
+        self.shuffle = ShuffleWorkerExtension(self)
+        self.profiler = None
+        if config.get("worker.profile.enabled"):
+            from distributed_tpu.diagnostics.profile import Profiler
+
+            self.profiler = Profiler()
         self.memory_manager = None
         if memory_limit:
             from distributed_tpu.worker.memory import WorkerMemoryManager
@@ -155,6 +168,24 @@ class Worker(Server):
             addr = "tcp://127.0.0.1:0"
         await self.listen(addr)
         self.state.address = self.address
+        from distributed_tpu.diagnostics.system_monitor import SystemMonitor
+        from distributed_tpu.http.server import HTTPServer, worker_metrics
+
+        self.monitor = SystemMonitor()
+        self.periodic_callbacks["monitor"] = PeriodicCallback(
+            self.monitor.update, 0.5
+        )
+        if self._http_port is not None:
+            self.http_server = HTTPServer(
+                {
+                    "/health": lambda: "ok",
+                    "/info": self.identity,
+                    "/metrics": lambda: worker_metrics(self),
+                    "/sysmon": lambda: self.monitor.range_query(),
+                },
+                port=self._http_port,
+            )
+            await self.http_server.start()
         await self._register_with_scheduler()
         if self.heartbeat_interval > 0:
             self.periodic_callbacks["heartbeat"] = PeriodicCallback(
@@ -163,6 +194,8 @@ class Worker(Server):
         self.periodic_callbacks["find-missing"] = PeriodicCallback(
             self.find_missing, 1.0
         )
+        if self.profiler is not None:
+            self.profiler.start()
         self.start_periodic_callbacks()
         return self
 
@@ -214,13 +247,19 @@ class Worker(Server):
             pass
 
     def metrics(self) -> dict:
-        return {
+        out = {
             "executing": len(self.state.executing),
             "ready": len(self.state.ready),
             "in_flight": len(self.state.in_flight_tasks),
             "in_memory": len(self.data),
             "memory": self.state.nbytes_in_memory,
         }
+        if self.monitor is not None:
+            out["host"] = self.monitor.recent()
+        if hasattr(self.data, "spilled_count"):
+            out["spilled_count"] = self.data.spilled_count
+            out["spilled_bytes"] = self.data.slow_bytes
+        return out
 
     async def find_missing(self) -> None:
         if any(ts.state == "missing" for ts in self.state.tasks.values()):
@@ -250,10 +289,14 @@ class Worker(Server):
         await self.batched_stream.close()
         if self.scheduler_comm is not None:
             await self.scheduler_comm.close()
+        if self.profiler is not None:
+            self.profiler.stop()
         self.executor.shutdown(wait=False)
         self.actor_executor.shutdown(wait=False)
         if hasattr(self.data, "close"):
             self.data.close()
+        if self.http_server is not None:
+            await self.http_server.stop()
         await super().close()
 
     async def close_rpc(self, reason: str = "") -> str:
@@ -362,6 +405,14 @@ class Worker(Server):
             return {"status": "OK", "result": Serialize(getattr(instance, attribute))}
         except Exception as e:
             return error_message(e)
+
+    async def get_profile(self, start: float | None = None) -> Any:
+        """Sampled call tree (reference worker.py:2449)."""
+        if self.profiler is None:
+            from distributed_tpu.diagnostics.profile import create
+
+            return Serialize(create())
+        return Serialize(self.profiler.get_profile(start=start))
 
     async def plugin_add(self, plugin: Any = None, name: str | None = None) -> dict:
         plugin = unwrap(plugin)
@@ -519,10 +570,25 @@ class Worker(Server):
             if hasattr(run_spec, "substitute"):
                 fn, args, kwargs = run_spec.substitute(self.data)
                 if asyncio.iscoroutinefunction(fn):
-                    value = await fn(*args, **kwargs)
+                    from distributed_tpu.worker.context import (
+                        reset_async_worker,
+                        set_async_worker,
+                    )
+
+                    token = set_async_worker(self)
+                    try:
+                        value = await fn(*args, **kwargs)
+                    finally:
+                        reset_async_worker(token)
                 else:
+                    from distributed_tpu.worker.context import set_thread_worker
+
+                    def _call(fn=fn, args=args, kwargs=kwargs):
+                        set_thread_worker(self)
+                        return fn(*args, **kwargs)
+
                     value = await asyncio.get_running_loop().run_in_executor(
-                        self.executor, lambda: fn(*args, **kwargs)
+                        self.executor, _call
                     )
                 if ts.actor:
                     # keep the instance resident; the task's value is a

@@ -1,0 +1,129 @@
+"""Observability tests: HTTP routes, Prometheus, SystemMonitor, task
+stream, profiler, events (reference http/*/tests, test_events patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time as _time
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+
+from conftest import gen_test
+
+
+async def new_cluster(**kwargs):
+    cluster = LocalCluster(
+        n_workers=kwargs.pop("n_workers", 2),
+        scheduler_kwargs={"validate": True, **kwargs.pop("scheduler_kwargs", {})},
+        worker_kwargs={"validate": True, **kwargs.pop("worker_kwargs", {})},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+async def http_get(port: int, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, body
+
+
+@gen_test()
+async def test_http_health_info_metrics():
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 1, range(5))
+            await c.gather(futs)
+            port = cluster.scheduler.http_server.port
+            status, body = await http_get(port, "/health")
+            assert status == 200 and body == b"ok"
+            status, body = await http_get(port, "/info")
+            info = json.loads(body)
+            assert info["type"] == "Scheduler"
+            assert len(info["workers"]) == 2
+            status, body = await http_get(port, "/metrics")
+            text = body.decode()
+            assert "dtpu_scheduler_workers 2" in text
+            assert "dtpu_scheduler_tasks" in text
+            status, body = await http_get(port, "/json/counts.json")
+            counts = json.loads(body)
+            assert counts["workers"] == 2
+            status, _ = await http_get(port, "/nope")
+            assert status == 404
+            # worker metrics too
+            wport = cluster.workers[0].http_server.port
+            status, body = await http_get(wport, "/metrics")
+            assert b"dtpu_worker_tasks_stored" in body
+
+
+@gen_test()
+async def test_system_monitor_samples():
+    async with await new_cluster(n_workers=1) as cluster:
+        mon = cluster.scheduler.monitor
+        mon.update()
+        mon.update()
+        recent = mon.recent()
+        assert recent["memory"] > 0
+        rq = mon.range_query()
+        assert len(rq["time"]) >= 2
+
+
+@gen_test()
+async def test_task_stream_records():
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x * 2, range(6), pure=False)
+            await c.gather(futs)
+            stream = await c.get_task_stream()
+            assert len(stream) == 6
+            rec = stream[0]
+            assert rec["worker"] is not None
+            assert rec["startstops"] and rec["startstops"][0]["action"] == "compute"
+
+
+@gen_test(timeout=60)
+async def test_profile_collects_samples():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def busy(x):
+                t0 = _time.time()
+                while _time.time() - t0 < 0.5:
+                    sum(range(1000))
+                return x
+
+            fut = c.submit(busy, 1)
+            await fut.result()
+            prof = await c.profile()
+            assert prof["count"] > 0
+            # the busy function appears somewhere in the tree
+            def find(node):
+                if "busy" in node.get("description", ""):
+                    return True
+                return any(find(ch) for ch in node.get("children", {}).values())
+
+            assert find(prof)
+
+
+@gen_test()
+async def test_events_and_subscription():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            seen: list = []
+            c.subscribe_topic("my-topic", seen.append)
+            await asyncio.sleep(0.05)
+            c.log_event("my-topic", {"x": 1})
+            for _ in range(100):
+                if seen:
+                    break
+                await asyncio.sleep(0.01)
+            assert seen == [{"x": 1}]
+            events = await c.get_events("my-topic")
+            assert len(events) == 1
+            assert events[0][1] == {"x": 1}

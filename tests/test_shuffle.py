@@ -1,0 +1,138 @@
+"""P2P shuffle tests (reference shuffle/tests patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.shuffle import p2p_rechunk, p2p_shuffle
+
+from conftest import gen_test
+
+
+async def new_cluster(n_workers=3, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+def make_partition(seed, n=50):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(0, 10_000, n)]
+
+
+@gen_test(timeout=120)
+async def test_hash_shuffle_repartitions_all_records():
+    async with await new_cluster(n_workers=3) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            inputs = [
+                c.submit(make_partition, i, key=f"input-{i}") for i in range(4)
+            ]
+            await c.gather(inputs)
+            outs = await p2p_shuffle(c, inputs, npartitions_out=5)
+            results = await asyncio.wait_for(c.gather(outs), 60)
+            # every record lands in exactly one output partition
+            all_in = sorted(x for i in range(4) for x in make_partition(i))
+            all_out = sorted(x for part in results for x in part)
+            assert all_out == all_in
+            # and in the right partition
+            for j, part in enumerate(results):
+                assert all(hash(x) % 5 == j for x in part)
+
+
+@gen_test(timeout=120)
+async def test_keyed_shuffle_groups_by_key():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def mk(i):
+                return [(k, i * 100 + n) for n, k in enumerate("abcd")]
+
+            inputs = [c.submit(mk, i, key=f"kin-{i}") for i in range(3)]
+            await c.gather(inputs)
+            outs = await p2p_shuffle(
+                c, inputs, npartitions_out=4, key=lambda rec: rec[0]
+            )
+            results = await asyncio.wait_for(c.gather(outs), 60)
+            # all records with the same key land in the same partition
+            for part in results:
+                keys_here = {rec[0] for rec in part}
+                for k in keys_here:
+                    total_with_k = sum(
+                        1 for p in results for rec in p if rec[0] == k
+                    )
+                    here_with_k = sum(1 for rec in part if rec[0] == k)
+                    assert total_with_k == here_with_k == 3
+
+
+@gen_test(timeout=120)
+async def test_shuffle_outputs_respect_worker_assignment():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            inputs = [
+                c.submit(make_partition, i, key=f"wi-{i}") for i in range(2)
+            ]
+            await c.gather(inputs)
+            outs = await p2p_shuffle(c, inputs, npartitions_out=4)
+            await asyncio.wait_for(c.gather(outs), 60)
+            # unpack tasks are pinned round-robin over the two workers
+            wh = await c.who_has(outs)
+            held = {addr for holders in wh.values() for addr in holders}
+            assert len(held) == 2
+
+
+@gen_test(timeout=120)
+async def test_rechunk_1d():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def mk_chunk(lo, n):
+                return np.arange(lo, lo + n)
+
+            chunk_sizes = [30, 30, 40]
+            offsets = [0, 30, 60]
+            chunks = [
+                c.submit(mk_chunk, offsets[i], chunk_sizes[i], key=f"ch-{i}")
+                for i in range(3)
+            ]
+            await c.gather(chunks)
+            new_sizes = [25, 25, 25, 25]
+            outs = await p2p_rechunk(c, chunks, chunk_sizes, new_sizes)
+            results = await asyncio.wait_for(c.gather(outs), 60)
+            assert [len(r) for r in results] == new_sizes
+            np.testing.assert_array_equal(
+                np.concatenate(results), np.arange(100)
+            )
+
+
+@gen_test(timeout=120)
+async def test_shuffle_run_id_fencing():
+    """A stale epoch's shards are rejected after a newer run starts."""
+    async with await new_cluster(n_workers=1) as cluster:
+        worker = cluster.workers[0]
+        from distributed_tpu.shuffle.core import ShuffleSpec
+
+        spec1 = ShuffleSpec("sx", 1, 2, {0: worker.address, 1: worker.address})
+        spec2 = ShuffleSpec("sx", 2, 2, {0: worker.address, 1: worker.address})
+        ext = worker.shuffle
+        run1 = ext.get_or_create(spec1)
+        run2 = ext.get_or_create(spec2)  # supersedes run1
+        assert run1.closed
+        resp = await ext.shuffle_receive(
+            id="sx", run_id=1, spec=spec1.to_msg(),
+            shards={0: [(0, [1, 2])]},
+        )
+        assert resp["status"] == "stale"
+        resp = await ext.shuffle_receive(
+            id="sx", run_id=2, spec=spec2.to_msg(),
+            shards={0: [(0, [3])]},
+        )
+        assert resp["status"] == "OK"
+        assert dict(run2.shards[0]) == {0: [3]}
