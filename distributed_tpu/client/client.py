@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import uuid
 from collections.abc import Iterable, Iterator
 from typing import Any, Callable
@@ -177,11 +178,23 @@ class Client:
         self._event_handlers: dict[str, list] = {}
         self._worker_rpcs: dict[str, Any] = {}
         self._generation = 0
+        self.loop: asyncio.AbstractEventLoop | None = None
         self._loop_runner: LoopRunner | None = None
         if not asynchronous:
             self._loop_runner = LoopRunner()
             self._loop_runner.start()
             self.sync(self._start)
+
+    # ------------------------------------------------------- sync facade
+
+    def gather_sync(self, futures: Any, errors: str = "raise") -> Any:
+        return self.sync(self.gather, futures, errors=errors)
+
+    def result_sync(self, future: "Future", timeout: float | None = None) -> Any:
+        return self.sync(future.result, timeout=timeout)
+
+    def scatter_sync(self, data: Any, **kwargs: Any) -> Any:
+        return self.sync(self.scatter, data, **kwargs)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -190,6 +203,7 @@ class Client:
         return self._loop_runner.run_sync(coro_fn, *args, **kwargs)
 
     async def _start(self) -> "Client":
+        self.loop = asyncio.get_running_loop()
         comm = await connect(self.address)
         await comm.write(
             {"op": "register-client", "client": self.id, "reply": False}
@@ -656,6 +670,80 @@ class Client:
         for st in self.futures.values():
             st.cancel()
 
+    async def rebalance(self, futures: Iterable[Future] | None = None,
+                        workers: list[str] | None = None) -> dict:
+        """Even data across workers (reference client.py:3824)."""
+        assert self.scheduler is not None
+        keys = [f.key for f in futures] if futures is not None else None
+        return await self.scheduler.rebalance(keys=keys, workers=workers)
+
+    async def register_plugin(self, plugin: Any, name: str | None = None) -> Any:
+        """Install a Scheduler/Worker/Nanny plugin cluster-wide
+        (reference client.py register_plugin)."""
+        from distributed_tpu.diagnostics.plugin import (
+            NannyPlugin,
+            SchedulerPlugin,
+            WorkerPlugin,
+        )
+
+        assert self.scheduler is not None
+        name = name or getattr(plugin, "name", None)
+        if isinstance(plugin, SchedulerPlugin):
+            return await self.scheduler.register_scheduler_plugin(
+                plugin=Serialize(plugin), name=name
+            )
+        if isinstance(plugin, NannyPlugin):
+            raise NotImplementedError("nanny plugins register via Nanny kwargs")
+        # default: worker plugin (reference treats unknown as worker plugin)
+        return await self.scheduler.register_worker_plugin(
+            plugin=Serialize(plugin), name=name
+        )
+
+    async def unregister_worker_plugin(self, name: str) -> Any:
+        assert self.scheduler is not None
+        return await self.scheduler.unregister_worker_plugin(name=name)
+
+    async def upload_file(self, path: str) -> None:
+        """Ship a source file to all current and future workers
+        (reference client.py:3767)."""
+        from distributed_tpu.diagnostics.plugin import UploadFile
+
+        await self.register_plugin(
+            UploadFile(path), name=f"upload-{os.path.basename(path)}"
+        )
+
+    async def dump_cluster_state(self, filename: str | None = None) -> dict:
+        """Full-state debug dump (reference client.py dump_cluster_state,
+        cluster_dump.py)."""
+        assert self.scheduler is not None
+        state = await self.scheduler.get_cluster_state()
+        if filename:
+            import json
+
+            with open(filename, "w") as f:
+                json.dump(state, f, default=str, indent=1)
+        return state
+
+    async def recreate_error_locally(self, future: Future) -> None:
+        """Re-run a failed task in this process for debugging
+        (reference recreate_tasks.py:15)."""
+        st = self.futures.get(future.key)
+        if st is None:
+            raise ValueError(f"unknown future {future.key}")
+        await st.event.wait()
+        if st.status != "error":
+            raise ValueError(f"future {future.key} did not err")
+        assert self.scheduler is not None
+        resp = await self.scheduler.get_runspec(key=future.key)
+        spec = unwrap(resp["run_spec"])
+        deps = await self._gather_keys(resp["deps"])
+        fn, args, kwargs = spec.substitute(deps)
+        # raises the task's error in the caller's process
+        if asyncio.iscoroutinefunction(fn):
+            await fn(*args, **kwargs)
+        else:
+            fn(*args, **kwargs)
+
     # ------------------------------------------------------- observability
 
     def log_event(self, topic: str, msg: Any) -> None:
@@ -744,6 +832,13 @@ class Client:
     async def scheduler_info(self) -> dict:
         assert self.scheduler is not None
         return await self.scheduler.identity()
+
+    def get_executor(self, **kwargs: Any):
+        """concurrent.futures.Executor facade (reference client.py
+        get_executor)."""
+        from distributed_tpu.client.cfexecutor import ClientExecutor
+
+        return ClientExecutor(self, **kwargs)
 
     def __repr__(self) -> str:
         return f"<Client {self.id!r} {self.status} scheduler={self.address!r}>"

@@ -32,18 +32,43 @@ def new_address() -> str:
 
 
 class _Channel:
-    """One direction: a deque + event for the reader."""
+    """One direction: a deque + event for the reader.
+
+    The writer may live on a different thread/loop (sync Client inside a
+    worker task, LoopRunner threads): waking the reader must then go
+    through ``call_soon_threadsafe`` — a bare ``Event.set()`` from a
+    foreign thread never wakes the waiting loop.
+    """
 
     def __init__(self):
         self.queue: deque = deque()
         self.event = asyncio.Event()
         self.closed = False
+        self._reader_loop: asyncio.AbstractEventLoop | None = None
+
+    def _wake(self) -> None:
+        loop = self._reader_loop
+        if loop is None or loop.is_closed():
+            self.event.set()
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self.event.set()
+        else:
+            try:
+                loop.call_soon_threadsafe(self.event.set)
+            except RuntimeError:
+                pass  # reader loop shut down
 
     def put(self, msg: Any) -> None:
         self.queue.append(msg)
-        self.event.set()
+        self._wake()
 
     async def get(self):
+        self._reader_loop = asyncio.get_running_loop()
         while not self.queue:
             if self.closed:
                 raise CommClosedError("inproc channel closed")
@@ -53,7 +78,7 @@ class _Channel:
 
     def close(self) -> None:
         self.closed = True
-        self.event.set()
+        self._wake()
 
 
 class InProc(Comm):

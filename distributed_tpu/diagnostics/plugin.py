@@ -1,0 +1,200 @@
+"""User-facing plugin hook surface (reference diagnostics/plugin.py).
+
+- ``SchedulerPlugin`` (reference :32): transition / add_worker /
+  remove_worker / add_client / remove_client / update_graph / log_event
+  hooks, registered live via ``Client.register_plugin``
+- ``WorkerPlugin`` (reference :212): setup / teardown / transition
+- ``NannyPlugin`` (reference :302): setup / teardown around the worker
+  subprocess
+
+Built-ins mirror the reference's: ``Environ`` (:852), ``UploadFile``
+(:738), ``ForwardLoggingPlugin`` (:771), ``PipInstall`` (:637), and the
+``KillWorker`` chaos plugin (chaos.py:14).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import sys
+from typing import Any
+
+logger = logging.getLogger("distributed_tpu.plugins")
+
+
+class SchedulerPlugin:
+    """Extend the scheduler (reference diagnostics/plugin.py:32)."""
+
+    name: str | None = None
+
+    async def start(self, scheduler: Any) -> None: ...
+    async def close(self) -> None: ...
+    def update_graph(self, scheduler: Any, **kwargs: Any) -> None: ...
+    def transition(self, key: str, start: str, finish: str,
+                   *args: Any, **kwargs: Any) -> None: ...
+    def add_worker(self, scheduler: Any, worker: str) -> None: ...
+    def remove_worker(self, scheduler: Any, worker: str) -> None: ...
+    def add_client(self, scheduler: Any, client: str) -> None: ...
+    def remove_client(self, scheduler: Any, client: str) -> None: ...
+    def log_event(self, topic: str, msg: Any) -> None: ...
+
+
+class WorkerPlugin:
+    """Extend every worker (reference diagnostics/plugin.py:212)."""
+
+    name: str | None = None
+
+    def setup(self, worker: Any) -> None: ...
+    def teardown(self, worker: Any) -> None: ...
+    def transition(self, key: str, start: str, finish: str,
+                   **kwargs: Any) -> None: ...
+
+
+class NannyPlugin:
+    """Extend every nanny (reference diagnostics/plugin.py:302)."""
+
+    name: str | None = None
+    restart = False
+
+    def setup(self, nanny: Any) -> None: ...
+    def teardown(self, nanny: Any) -> None: ...
+
+
+# ------------------------------------------------------------- built-ins
+
+
+class Environ(WorkerPlugin):
+    """Set environment variables on every worker (reference plugin.py:852)."""
+
+    name = "environ"
+
+    def __init__(self, environ: dict | None = None):
+        self.environ = {k: str(v) for k, v in (environ or {}).items()}
+
+    def setup(self, worker: Any) -> None:
+        os.environ.update(self.environ)
+
+
+class UploadFile(WorkerPlugin):
+    """Ship a local file to every worker (reference plugin.py:738)."""
+
+    name = "upload-file"
+
+    def __init__(self, filepath: str, load: bool = True):
+        self.filename = os.path.basename(filepath)
+        self.load = load
+        with open(filepath, "rb") as f:
+            self.data = f.read()
+
+    def setup(self, worker: Any) -> None:
+        path = os.path.join(os.getcwd(), self.filename)
+        with open(path, "wb") as f:
+            f.write(self.data)
+        if self.load and self.filename.endswith((".py", ".zip", ".egg")):
+            directory = os.path.dirname(path) or os.getcwd()
+            if directory not in sys.path:
+                sys.path.insert(0, directory)
+            if self.filename.endswith(".py"):
+                import importlib
+
+                modname = self.filename[:-3]
+                if modname in sys.modules:
+                    importlib.reload(sys.modules[modname])
+                else:
+                    importlib.import_module(modname)
+
+
+class ForwardLoggingPlugin(WorkerPlugin):
+    """Forward worker log records to the scheduler event log
+    (reference plugin.py:771)."""
+
+    name = "forward-logging"
+
+    def __init__(self, logger_name: str = "", level: int = logging.WARNING):
+        self.logger_name = logger_name
+        self.level = level
+        self._handler: logging.Handler | None = None
+
+    def setup(self, worker: Any) -> None:
+        plugin = self
+
+        class _Handler(logging.Handler):
+            def emit(self, record: logging.LogRecord) -> None:
+                try:
+                    worker.batched_stream.send(
+                        {
+                            "op": "log-event",
+                            "topic": "forwarded-log",
+                            "msg": {
+                                "name": record.name,
+                                "level": record.levelname,
+                                "message": record.getMessage(),
+                            },
+                        }
+                    )
+                except Exception:
+                    pass
+
+        self._handler = _Handler(level=self.level)
+        logging.getLogger(self.logger_name).addHandler(self._handler)
+
+    def teardown(self, worker: Any) -> None:
+        if self._handler is not None:
+            logging.getLogger(self.logger_name).removeHandler(self._handler)
+
+
+class PipInstall(WorkerPlugin):
+    """pip-install packages on every worker (reference plugin.py:637)."""
+
+    name = "pip-install"
+
+    def __init__(self, packages: list[str], pip_options: list[str] | None = None,
+                 restart_workers: bool = False):
+        self.packages = list(packages)
+        self.pip_options = list(pip_options or [])
+
+    async def setup(self, worker: Any) -> None:
+        import subprocess
+
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: subprocess.run(
+                [sys.executable, "-m", "pip", "install", *self.pip_options,
+                 *self.packages],
+                capture_output=True,
+            ),
+        )
+        if proc.returncode != 0:
+            logger.error("pip install failed: %s", proc.stderr.decode()[-1000:])
+
+
+class KillWorker(WorkerPlugin):
+    """Chaos: kill the worker on an exponential clock (reference chaos.py:14).
+
+    mode 'sys.exit' raises SystemExit in the worker process, 'graceful'
+    closes it cleanly, 'segfault' dies hard.
+    """
+
+    name = "kill-worker"
+
+    def __init__(self, delay: float = 1.0, mode: str = "sys.exit"):
+        assert mode in ("sys.exit", "graceful", "segfault")
+        self.delay = delay
+        self.mode = mode
+
+    def setup(self, worker: Any) -> None:
+        delay = random.expovariate(1 / self.delay)
+        worker._ongoing_background_tasks.call_later(delay, self._kill, worker)
+
+    async def _kill(self, worker: Any) -> None:
+        logger.warning("KillWorker firing (%s) on %s", self.mode, worker.address)
+        if self.mode == "graceful":
+            await worker.close()
+        elif self.mode == "sys.exit":
+            os._exit(1)
+        else:  # segfault
+            import ctypes
+
+            ctypes.string_at(0)

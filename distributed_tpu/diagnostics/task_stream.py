@@ -53,7 +53,9 @@ class TaskStreamPlugin:
         if start is not None:
             out = [
                 rec for rec in out
-                if any(ss.get("stop", 0) >= start for ss in rec["startstops"])
+                # records without timing info (errors) always pass
+                if not rec["startstops"]
+                or any(ss.get("stop", 0) >= start for ss in rec["startstops"])
             ]
         if count is not None:
             out = out[-count:]

@@ -118,6 +118,13 @@ class Scheduler(Server):
             "missing_workers": self.get_missing_workers,
             "retire_workers": self.retire_workers,
             "remove_worker": self.remove_worker_handler,
+            "rebalance": self.rebalance,
+            "register_scheduler_plugin": self.register_scheduler_plugin,
+            "unregister_scheduler_plugin": self.unregister_scheduler_plugin,
+            "register_worker_plugin": self.register_worker_plugin,
+            "unregister_worker_plugin": self.unregister_worker_plugin,
+            "get_cluster_state": self.get_cluster_state,
+            "get_runspec": self.get_runspec,
         }
         stream_handlers = {
             # from workers
@@ -152,6 +159,7 @@ class Scheduler(Server):
         self.task_stream = TaskStreamPlugin(self)
         self._topic_subscribers: dict[str, set[str]] = {}
         self.state.events_subscriber_hook = self._fan_out_event
+        self.worker_plugins: dict[str, Any] = {}  # shipped to joining workers
         self.handlers["get_task_stream"] = self.get_task_stream
         self.handlers["get_profile"] = self.get_profile
         self.stream_handlers["subscribe-topic"] = self.subscribe_topic
@@ -313,6 +321,10 @@ class Scheduler(Server):
                     cb(self, address)
                 except Exception:
                     logger.exception("extension add_worker failed")
+        for pname, plugin in self.worker_plugins.items():
+            self._ongoing_background_tasks.call_soon(
+                self._send_plugin_to_worker, address, pname, plugin
+            )
 
         try:
             await self.handle_stream(comm, extra={"worker": address})
@@ -894,6 +906,182 @@ class Scheduler(Server):
 
     async def get_missing_workers(self) -> list:
         return []
+
+    # ---------------------------------------------------- plugins / state ops
+
+    async def _send_plugin_to_worker(self, address: str, name: str,
+                                     plugin: Any) -> None:
+        try:
+            await self.rpc(address).plugin_add(plugin=plugin, name=name)
+        except (CommClosedError, OSError):
+            pass
+
+    async def register_scheduler_plugin(self, plugin: Any = None,
+                                        name: str | None = None,
+                                        idempotent: bool = False) -> str:
+        """Install a live SchedulerPlugin (reference scheduler.py:5699)."""
+        plugin = unwrap(plugin)
+        name = name or getattr(plugin, "name", None) or f"plugin-{len(self.state.plugins)}"
+        if idempotent and name in self.state.plugins:
+            return "OK"
+        start = getattr(plugin, "start", None)
+        if start is not None:
+            res = start(self)
+            if asyncio.iscoroutine(res):
+                await res
+        self.state.plugins[name] = plugin
+        return "OK"
+
+    async def unregister_scheduler_plugin(self, name: str = "") -> str:
+        plugin = self.state.plugins.pop(name, None)
+        if plugin is not None:
+            close = getattr(plugin, "close", None)
+            if close is not None:
+                res = close()
+                if asyncio.iscoroutine(res):
+                    await res
+        return "OK"
+
+    async def register_worker_plugin(self, plugin: Any = None,
+                                     name: str | None = None) -> dict:
+        """Install a WorkerPlugin on every current and future worker
+        (reference scheduler.py:7425)."""
+        name = name or f"worker-plugin-{len(self.worker_plugins)}"
+        # re-wrap: over tcp the comm already deserialized the plugin, and
+        # it must cross the scheduler->worker wire pickled again
+        plugin = Serialize(unwrap(plugin))
+        self.worker_plugins[name] = plugin
+        out = await self.broadcast(
+            msg={"op": "plugin_add", "plugin": plugin, "name": name}
+        )
+        return out
+
+    async def unregister_worker_plugin(self, name: str = "") -> dict:
+        self.worker_plugins.pop(name, None)
+        return await self.broadcast(
+            msg={"op": "plugin_remove", "name": name}
+        )
+
+    async def rebalance(self, keys: Iterable[Key] | None = None,
+                        workers: list[str] | None = None, **kwargs: Any) -> dict:
+        """Even out managed memory across workers (reference scheduler.py:6501).
+
+        Two-phase like the reference: compute sender->recipient moves from
+        the memory distribution (:6605), then enact them (:6795): the
+        recipient gathers the key from the sender, then the sender drops
+        its replica.
+        """
+        s = self.state
+        wss = [
+            s.workers[w] for w in (workers or list(s.workers))
+            if w in s.workers
+        ]
+        if len(wss) < 2:
+            return {"status": "OK", "moves": 0}
+        keyset = set(keys) if keys is not None else None
+        mean = sum(ws.nbytes for ws in wss) / len(wss)
+        senders = sorted(
+            (ws for ws in wss if ws.nbytes > mean * 1.05),
+            key=lambda ws: -ws.nbytes,
+        )
+        recipients = sorted(
+            (ws for ws in wss if ws.nbytes < mean * 0.95),
+            key=lambda ws: ws.nbytes,
+        )
+        moves: list[tuple] = []  # (ts, sender, recipient)
+        projected = {ws: ws.nbytes for ws in wss}
+        for sender in senders:
+            for ts in sorted(sender.has_what, key=lambda t: -t.get_nbytes()):
+                if projected[sender] <= mean:
+                    break
+                if keyset is not None and ts.key not in keyset:
+                    continue
+                if ts.actor or len(ts.who_has) != 1 or ts.state != "memory":
+                    continue
+                if not recipients:
+                    break
+                recipient = recipients[0]
+                if projected[recipient] + ts.get_nbytes() > mean:
+                    recipients.sort(key=lambda ws: projected[ws])
+                    recipient = recipients[0]
+                    if projected[recipient] + ts.get_nbytes() > mean * 1.05:
+                        continue
+                moves.append((ts, sender, recipient))
+                projected[sender] -= ts.get_nbytes()
+                projected[recipient] += ts.get_nbytes()
+                recipients.sort(key=lambda ws: projected[ws])
+
+        n_ok = 0
+        for ts, sender, recipient in moves:
+            if ts.state != "memory" or sender not in ts.who_has:
+                continue
+            try:
+                resp = await self.rpc(recipient.address).gather(
+                    who_has={ts.key: [sender.address]}
+                )
+            except (CommClosedError, OSError):
+                continue
+            if resp.get("status") != "OK":
+                continue
+            # gather -> add-keys already registered the new replica when
+            # the stream message lands; register eagerly + drop the old one
+            if recipient not in ts.who_has:
+                s.add_replica(ts, recipient)
+            self.send_all({}, {sender.address: [{
+                "op": "remove-replicas", "keys": [ts.key],
+                "stimulus_id": seq_name("rebalance"),
+            }]})
+            n_ok += 1
+        return {"status": "OK", "moves": n_ok}
+
+    async def get_runspec(self, key: Key = "") -> dict:
+        """Fetch a task's spec + dependency keys for client-side replay
+        (reference recreate_tasks.py ReplayTaskScheduler)."""
+        ts = self.state.tasks.get(key)
+        if ts is None:
+            raise KeyError(key)
+        return {
+            "run_spec": Serialize(ts.run_spec),
+            "deps": [d.key for d in ts.dependencies],
+        }
+
+    async def get_cluster_state(self, exclude: list[str] | None = None) -> dict:
+        """Debug dump of the whole cluster (reference scheduler.py:3964)."""
+        s = self.state
+        scheduler_info = {
+            "address": self.address,
+            "id": self.id,
+            "tasks": {
+                k: {
+                    "state": ts.state,
+                    "priority": ts.priority,
+                    "who_has": [ws.address for ws in ts.who_has],
+                    "processing_on": (
+                        ts.processing_on.address if ts.processing_on else None
+                    ),
+                    "nbytes": ts.nbytes,
+                    "dependencies": [d.key for d in ts.dependencies],
+                }
+                for k, ts in s.tasks.items()
+            },
+            "workers": {
+                addr: {
+                    "name": str(ws.name),
+                    "nthreads": ws.nthreads,
+                    "nbytes": ws.nbytes,
+                    "status": str(ws.status),
+                    "processing": [ts.key for ts in ws.processing],
+                    "has_what": [ts.key for ts in ws.has_what],
+                }
+                for addr, ws in s.workers.items()
+            },
+            "clients": {c: [ts.key for ts in cs.wants_what]
+                        for c, cs in s.clients.items()},
+            "events": {t: len(evs) for t, evs in s.events.items()},
+            "transition_log_length": len(s.transition_log),
+        }
+        worker_info = await self.broadcast(msg={"op": "identity"})
+        return {"scheduler": scheduler_info, "workers": worker_info}
 
     def _counts_json(self) -> dict:
         s = self.state

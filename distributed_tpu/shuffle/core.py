@@ -84,6 +84,14 @@ class ShuffleRun:
         self.local_outputs_left = sum(
             1 for addr in spec.worker_for.values() if addr == worker.address
         )
+        from distributed_tpu.utils.misc import time as _now
+
+        self.last_activity = _now()
+
+    def touch(self) -> None:
+        from distributed_tpu.utils.misc import time as _now
+
+        self.last_activity = _now()
 
     @property
     def id(self) -> str:
@@ -101,6 +109,7 @@ class ShuffleRun:
         (reference shuffle/_core.py:331)."""
         if self.closed:
             raise ShuffleClosedError(self.id)
+        self.touch()
         out_shards = splitter(data, self.spec.npartitions_out)
         by_worker: defaultdict[str, dict[int, list]] = defaultdict(dict)
         for j, shard in out_shards.items():
@@ -131,6 +140,7 @@ class ShuffleRun:
         """Accept shards pushed by a peer (reference shuffle/_core.py:260)."""
         if self.closed:
             raise ShuffleClosedError(self.id)
+        self.touch()
         for j, tagged in shards.items():
             bucket = self.shards[int(j)]
             for tag, shard in tagged:
@@ -157,7 +167,9 @@ class ShuffleRun:
     async def get_output_partition(self, j: int, assembler: Callable,
                                    timeout: float = 30.0) -> Any:
         """Assemble output partition j (reference shuffle/_core.py:353)."""
+        self.touch()
         await asyncio.wait_for(self.inputs_done.wait(), timeout)
+        self.touch()
         bucket = self.shards.pop(j, {})
         self.local_outputs_left -= 1
         if self.local_outputs_left <= 0:
@@ -190,9 +202,13 @@ class ShuffleWorkerExtension:
                     f"{spec.id} run {spec.run_id} superseded by {run.run_id}"
                 )
             if run.run_id == spec.run_id:
+                run.touch()
                 return run
             run.close()  # stale epoch: replace
         run = self.runs[spec.id] = ShuffleRun(spec, self.worker)
+        # TTL backstop: runs whose outputs are never unpacked (transfer-only
+        # workers, cancelled shuffles) must not accumulate forever
+        self.schedule_cleanup(spec.id, spec.run_id, delay=self.RUN_TTL)
         return run
 
     def _get_checked(self, id: str, run_id: int) -> ShuffleRun | None:
@@ -228,14 +244,25 @@ class ShuffleWorkerExtension:
         run.inputs_done.set()
         return {"status": "OK"}
 
+    RUN_TTL = 300.0  # forget idle runs after this long
+
     def schedule_cleanup(self, id: str, run_id: int, delay: float = 30.0) -> None:
-        """Forget a completed run after a grace period."""
+        """Forget a run after a grace period; reschedules while active."""
 
         async def _cleanup() -> None:
+            from distributed_tpu.utils.misc import time as _now
+
             run = self.runs.get(id)
-            if run is not None and run.run_id == run_id:
+            if run is None or run.run_id != run_id:
+                return
+            idle = _now() - run.last_activity
+            if run.local_outputs_left <= 0 or idle >= self.RUN_TTL:
                 run.close()
                 del self.runs[id]
+            else:
+                self.schedule_cleanup(
+                    id, run_id, delay=max(self.RUN_TTL - idle, 5.0)
+                )
 
         self.worker._ongoing_background_tasks.call_later(delay, _cleanup)
 

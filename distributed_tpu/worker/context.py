@@ -22,8 +22,13 @@ _async_worker: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
-def set_thread_worker(worker: "Worker") -> None:
+def set_thread_worker(worker: "Worker", key: str | None = None) -> None:
     _thread_state.worker = worker
+    _thread_state.key = key
+
+
+def get_thread_key() -> str | None:
+    return getattr(_thread_state, "key", None)
 
 
 def set_async_worker(worker: "Worker"):

@@ -163,6 +163,7 @@ class Worker(Server):
     # ------------------------------------------------------------ lifecycle
 
     async def start_unsafe(self) -> "Worker":
+        self.loop = asyncio.get_running_loop()
         addr = self._listen_addr
         if addr is None:
             addr = "tcp://127.0.0.1:0"
@@ -584,7 +585,7 @@ class Worker(Server):
                     from distributed_tpu.worker.context import set_thread_worker
 
                     def _call(fn=fn, args=args, kwargs=kwargs):
-                        set_thread_worker(self)
+                        set_thread_worker(self, key)
                         return fn(*args, **kwargs)
 
                     value = await asyncio.get_running_loop().run_in_executor(

@@ -1,0 +1,174 @@
+"""Plugin API, rebalance, cluster dump, worker_client, executor tests
+(reference test_worker_plugins, test_client_executor, test_rebalance
+patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.diagnostics.plugin import SchedulerPlugin, WorkerPlugin
+
+from conftest import gen_test
+
+
+async def new_cluster(n_workers=2, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        scheduler_kwargs={"validate": True, **kwargs.pop("scheduler_kwargs", {})},
+        worker_kwargs={"validate": True, **kwargs.pop("worker_kwargs", {})},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+class CountingWorkerPlugin(WorkerPlugin):
+    name = "counter-plugin"
+
+    def __init__(self):
+        self.setup_calls = 0
+
+    def setup(self, worker):
+        self.setup_calls += 1
+        worker._counting_plugin_active = True
+
+    def teardown(self, worker):
+        worker._counting_plugin_active = False
+
+
+class TransitionRecorder(SchedulerPlugin):
+    name = "transition-recorder"
+
+    def __init__(self):
+        self.transitions = []
+
+    def transition(self, key, start, finish, *args, **kwargs):
+        self.transitions.append((key, start, finish))
+
+
+@gen_test()
+async def test_worker_plugin_on_existing_and_new_workers():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.register_plugin(CountingWorkerPlugin())
+            assert getattr(cluster.workers[0], "_counting_plugin_active", False)
+            # a later-joining worker gets it too
+            w2 = await cluster.add_worker(name="late")
+            for _ in range(100):
+                if getattr(w2, "_counting_plugin_active", False):
+                    break
+                await asyncio.sleep(0.01)
+            assert w2._counting_plugin_active
+            await c.unregister_worker_plugin("counter-plugin")
+            assert not cluster.workers[0]._counting_plugin_active
+
+
+@gen_test()
+async def test_scheduler_plugin_sees_transitions():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            rec = TransitionRecorder()
+            # register in-process (inproc comm passes the object through)
+            await c.register_plugin(rec)
+            fut = c.submit(lambda: 1, key="plugged")
+            await fut.result()
+            plugin = cluster.scheduler.state.plugins["transition-recorder"]
+            states = [(s, f) for k, s, f in plugin.transitions if k == "plugged"]
+            assert ("waiting", "processing") in states
+            assert ("processing", "memory") in states
+
+
+@gen_test()
+async def test_rebalance_evens_memory():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            w0 = cluster.workers[0].address
+            # pile data onto worker 0 only
+            futs = c.map(
+                lambda i: bytes(50_000), range(8), workers=[w0], pure=False
+            )
+            await c.gather(futs)
+            assert len(cluster.workers[1].data) == 0
+            out = await c.rebalance()
+            assert out["moves"] > 0
+            total = sum(len(w.data) for w in cluster.workers)
+            for _ in range(100):
+                if len(cluster.workers[1].data) > 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(cluster.workers[1].data) > 0
+            # nothing lost
+            results = await c.gather(futs)
+            assert all(len(r) == 50_000 for r in results)
+
+
+@gen_test()
+async def test_cluster_dump():
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 1, range(4))
+            await c.gather(futs)
+            dump = await c.dump_cluster_state()
+            assert len(dump["scheduler"]["workers"]) == 2
+            assert len(dump["scheduler"]["tasks"]) == 4
+            assert all(
+                t["state"] == "memory"
+                for t in dump["scheduler"]["tasks"].values()
+            )
+
+
+@gen_test()
+async def test_recreate_error_locally():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def boom(x):
+                raise ValueError("recreate-me")
+
+            fut = c.submit(boom, 5, key="boom-task")
+            with pytest.raises(ValueError):
+                await fut.result()
+            with pytest.raises(ValueError, match="recreate-me"):
+                await c.recreate_error_locally(fut)
+
+
+@gen_test(timeout=90)
+async def test_worker_client_subtasks():
+    """A task spawns sub-tasks via worker_client (reference
+    test_worker_client patterns)."""
+    async with await new_cluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def parent(n):
+                from distributed_tpu.client.worker_client import worker_client
+
+                with worker_client() as wc:
+                    futs = [wc.submit(lambda x: x * 2, i, pure=False)
+                            for i in range(n)]
+                    return sorted(wc.gather_sync(futs))
+
+            fut = c.submit(parent, 4)
+            assert await asyncio.wait_for(fut.result(), 60) == [0, 2, 4, 6]
+
+
+def test_client_executor_facade():
+    """ClientExecutor: stdlib executor API over the cluster."""
+    import asyncio as aio
+
+    async def main():
+        async with await new_cluster(n_workers=2) as cluster:
+            c = Client(cluster.scheduler_address)
+            async with c:
+                ex = c.get_executor()
+                cfut = ex.submit(lambda x: x + 100, 1)
+                result = await aio.get_running_loop().run_in_executor(
+                    None, cfut.result, 30
+                )
+                assert result == 101
+                ex.shutdown(wait=False)
+
+    aio.run(main())
