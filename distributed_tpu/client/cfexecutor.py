@@ -25,9 +25,8 @@ class ClientExecutor(cf.Executor):
         if self._shutdown:
             raise RuntimeError("executor has been shut down")
         assert self.client.loop is not None, "client not started"
-        fut = self.client.submit(
-            fn, *args, pure=False, **self.submit_kwargs, **kwargs
-        )
+        merged = {"pure": False, **self.submit_kwargs, **kwargs}
+        fut = self.client.submit(fn, *args, **merged)
         cfut: cf.Future = cf.Future()  # stays PENDING: cancel() works
         self._futures.add(cfut)
         self._cluster_futures[cfut] = fut
@@ -50,11 +49,18 @@ class ClientExecutor(cf.Executor):
 
     def map(self, fn: Callable, *iterables: Any, timeout: float | None = None,
             chunksize: int = 1) -> Any:
+        import time as _time
+
         futs = [self.submit(fn, *args) for args in zip(*iterables)]
+        # stdlib semantics: timeout is an overall deadline, not per-future
+        end_time = None if timeout is None else timeout + _time.monotonic()
 
         def gen():
             for f in futs:
-                yield f.result(timeout)
+                remaining = (
+                    None if end_time is None else end_time - _time.monotonic()
+                )
+                yield f.result(remaining)
 
         return gen()
 

@@ -203,6 +203,7 @@ def _ensure_default_backends() -> None:
     _defaults_loaded = True
     import distributed_tpu.comm.inproc  # noqa: F401 registers inproc
     import distributed_tpu.comm.tcp  # noqa: F401 registers tcp/tls
+    import distributed_tpu.comm.ws  # noqa: F401 registers ws
 
 
 from contextlib import contextmanager

@@ -1,0 +1,363 @@
+"""WebSocket comm backend (reference comm/ws.py).
+
+Minimal RFC 6455 over asyncio streams — no web-framework dependency.
+Each protocol message (the same ``dumps`` frame list as tcp) is packed
+into ONE binary WebSocket message with internal length prefixes:
+
+    uint64 n_frames, uint64 length[n], frame bytes...
+
+Client->server frames are masked per the RFC; fragmentation uses 8 MiB
+continuation frames like the reference's shards (comm/ws.py 8MiB).
+Useful where only HTTP-shaped traffic traverses a proxy/ingress.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import os
+import struct
+from typing import Any, Callable
+
+from distributed_tpu.comm.addressing import parse_host_port, unparse_host_port
+from distributed_tpu.comm.core import Backend, Comm, Connector, Listener, register_backend
+from distributed_tpu.exceptions import CommClosedError, FatalCommClosedError
+from distributed_tpu.protocol import dumps, loads
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+_u64 = struct.Struct("<Q")
+FRAGMENT_SIZE = 8 * 2**20  # reference comm/ws.py shards at 8 MiB
+
+
+def _accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((key + _GUID).encode()).digest()
+    ).decode()
+
+
+async def _read_ws_message(reader: asyncio.StreamReader,
+                           pong: Callable[[bytes], None] | None = None) -> bytes:
+    """Read one complete (possibly fragmented) binary message; answers
+    pings via ``pong`` (RFC 6455 §5.5.2 — proxies health-check with them)."""
+    parts: list[bytes] = []
+    while True:
+        head = await reader.readexactly(2)
+        fin = head[0] & 0x80
+        opcode = head[0] & 0x0F
+        masked = head[1] & 0x80
+        length = head[1] & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", await reader.readexactly(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", await reader.readexactly(8))
+        mask = await reader.readexactly(4) if masked else None
+        payload = await reader.readexactly(length) if length else b""
+        if mask:
+            payload = bytes(
+                b ^ mask[i % 4] for i, b in enumerate(payload)
+            ) if length < 65536 else _unmask(payload, mask)
+        if opcode == 0x8:  # close
+            raise CommClosedError("ws close frame")
+        if opcode == 0x9:  # ping -> pong with the same payload
+            if pong is not None:
+                pong(payload)
+            continue
+        if opcode == 0xA:  # pong
+            continue
+        parts.append(payload)
+        if fin:
+            return b"".join(parts)
+
+
+def _unmask(payload: bytes, mask: bytes) -> bytes:
+    import numpy as np
+
+    data = np.frombuffer(payload, np.uint8).copy()
+    m = np.frombuffer((mask * ((len(payload) + 3) // 4))[: len(payload)], np.uint8)
+    return (data ^ m).tobytes()
+
+
+def _mask_payload(payload: bytes, mask: bytes) -> bytes:
+    if len(payload) < 65536:
+        return bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return _unmask(payload, mask)  # xor is symmetric
+
+
+def _ws_frames(payload: bytes, *, mask: bool) -> bytes:
+    """Encode one binary message, fragmenting at FRAGMENT_SIZE."""
+    out = bytearray()
+    offset = 0
+    first = True
+    total = len(payload)
+    while first or offset < total:
+        chunk = payload[offset:offset + FRAGMENT_SIZE]
+        offset += len(chunk)
+        fin = 0x80 if offset >= total else 0
+        opcode = 0x2 if first else 0x0
+        first = False
+        head = bytearray([fin | opcode])
+        n = len(chunk)
+        mask_bit = 0x80 if mask else 0
+        if n < 126:
+            head.append(mask_bit | n)
+        elif n < 65536:
+            head.append(mask_bit | 126)
+            head += struct.pack(">H", n)
+        else:
+            head.append(mask_bit | 127)
+            head += struct.pack(">Q", n)
+        if mask:
+            mkey = os.urandom(4)
+            head += mkey
+            chunk = _mask_payload(chunk, mkey)
+        out += head
+        out += chunk
+    return bytes(out)
+
+
+class WS(Comm):
+    scheme = "ws"
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 local_addr: str, peer_addr: str, *, is_client: bool,
+                 deserialize: bool = True):
+        super().__init__(deserialize=deserialize)
+        self._reader = reader
+        self._writer = writer
+        self._local_addr = local_addr
+        self._peer_addr = peer_addr
+        self._is_client = is_client  # clients mask their frames
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+
+    def _send_pong(self, payload: bytes) -> None:
+        try:
+            head = bytearray([0x8A])  # FIN + pong
+            n = len(payload)
+            if self._is_client:
+                head.append(0x80 | n)
+                mkey = os.urandom(4)
+                head += mkey
+                payload = _mask_payload(payload, mkey)
+            else:
+                head.append(n)
+            self._writer.write(bytes(head) + payload)
+        except Exception:
+            pass
+
+    async def read(self) -> Any:
+        try:
+            payload = await _read_ws_message(self._reader, pong=self._send_pong)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
+                CommClosedError) as e:
+            self.abort()
+            raise CommClosedError(f"ws read failed: {e!r}") from e
+        try:
+            (n_frames,) = _u64.unpack(payload[:8])
+            lengths = struct.unpack_from(f"<{n_frames}Q", payload, 8)
+            frames = []
+            offset = 8 + 8 * n_frames
+            for n in lengths:
+                frames.append(payload[offset:offset + n])
+                offset += n
+            return loads(frames, deserializers=self.deserialize)
+        except Exception:
+            self.abort()
+            raise
+
+    async def write(self, msg: Any, on_error: str = "message") -> int:
+        compression = self.handshake_options.get("compression", "auto")
+        frames = dumps(msg, compression=compression)
+        lengths = [memoryview(f).nbytes for f in frames]
+        payload = (
+            _u64.pack(len(frames))
+            + struct.pack(f"<{len(frames)}Q", *lengths)
+            + b"".join(bytes(f) for f in frames)
+        )
+        encoded = _ws_frames(payload, mask=self._is_client)
+        async with self._write_lock:
+            try:
+                self._writer.write(encoded)
+                await self._writer.drain()
+            except (ConnectionResetError, BrokenPipeError, RuntimeError,
+                    OSError) as e:
+                self.abort()
+                raise CommClosedError(f"ws write failed: {e!r}") from e
+        return len(encoded)
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # close frame (masked if client)
+            self._writer.write(
+                b"\x88\x80" + os.urandom(4) if self._is_client else b"\x88\x00"
+            )
+            self._writer.close()
+            await asyncio.wait_for(self._writer.wait_closed(), 1.0)
+        except Exception:
+            pass
+
+    def abort(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._writer.transport.abort()
+            except Exception:
+                pass
+
+    @property
+    def local_address(self) -> str:
+        return self._local_addr
+
+    @property
+    def peer_address(self) -> str:
+        return self._peer_addr
+
+    @property
+    def closed(self) -> bool:
+        return self._closed or self._reader.at_eof()
+
+
+class WSListener(Listener):
+    prefix = "ws"
+
+    def __init__(self, loc: str, handle_comm: Callable, deserialize: bool = True,
+                 **kwargs: Any):
+        self.loc = loc
+        self.handle_comm = handle_comm
+        self.deserialize = deserialize
+        self._server: asyncio.AbstractServer | None = None
+        self.bound_port: int | None = None
+
+    async def start(self) -> None:
+        host, port = parse_host_port(self.loc, 0)
+        self._server = await asyncio.start_server(
+            self._handle_connection, host or "127.0.0.1", port
+        )
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            # HTTP upgrade handshake
+            request = await asyncio.wait_for(reader.readline(), 10)
+            headers = {}
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            key = headers.get("sec-websocket-key")
+            if not key or b"GET" not in request:
+                writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+                await writer.drain()
+                writer.close()
+                return
+            writer.write(
+                (
+                    "HTTP/1.1 101 Switching Protocols\r\n"
+                    "Upgrade: websocket\r\n"
+                    "Connection: Upgrade\r\n"
+                    f"Sec-WebSocket-Accept: {_accept_key(key)}\r\n"
+                    "\r\n"
+                ).encode()
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, OSError):
+            writer.close()
+            return
+        peer = writer.get_extra_info("peername") or ("unknown", 0)
+        comm = WS(
+            reader, writer,
+            local_addr=self.contact_address,
+            peer_addr=f"ws://{peer[0]}:{peer[1]}",
+            is_client=False,
+            deserialize=self.deserialize,
+        )
+        try:
+            await self.on_connection(comm)
+        except CommClosedError:
+            return
+        await self.handle_comm(comm)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    @property
+    def listen_address(self) -> str:
+        host, _ = parse_host_port(self.loc, 0)
+        return f"ws://{unparse_host_port(host or '127.0.0.1', self.bound_port)}"
+
+    @property
+    def contact_address(self) -> str:
+        return self.listen_address
+
+
+class WSConnector(Connector):
+    async def connect(self, address: str, deserialize: bool = True,
+                      **kwargs: Any) -> Comm:
+        host, port = parse_host_port(address, 80)
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            raise CommClosedError(f"ws connect to {address} failed: {e}") from e
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write(
+            (
+                f"GET / HTTP/1.1\r\n"
+                f"Host: {host}:{port}\r\n"
+                "Upgrade: websocket\r\n"
+                "Connection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n"
+                "\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        status = await reader.readline()
+        if b"101" not in status:
+            writer.close()
+            raise FatalCommClosedError(f"ws handshake rejected: {status!r}")
+        accept = None
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode("latin1").partition(":")
+            if k.strip().lower() == "sec-websocket-accept":
+                accept = v.strip()
+        if accept != _accept_key(key):
+            writer.close()
+            raise FatalCommClosedError("ws handshake: bad accept key")
+        sock = writer.get_extra_info("sockname")
+        return WS(
+            reader, writer,
+            local_addr=f"ws://{sock[0]}:{sock[1]}" if sock else "ws://local",
+            peer_addr=f"ws://{host}:{port}",
+            is_client=True,
+            deserialize=deserialize,
+        )
+
+
+class WSBackend(Backend):
+    def get_connector(self) -> Connector:
+        return WSConnector()
+
+    def get_listener(self, loc: str, handle_comm: Callable, deserialize: bool,
+                     **kwargs: Any) -> Listener:
+        return WSListener(loc, handle_comm, deserialize, **kwargs)
+
+    def get_address_host(self, loc: str) -> str:
+        return parse_host_port(loc, 0)[0]
+
+    def get_local_address_for(self, loc: str) -> str:
+        return "ws://" + loc
+
+
+register_backend("ws", WSBackend())

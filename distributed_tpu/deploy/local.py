@@ -34,9 +34,12 @@ class LocalCluster:
         self.n_workers = n_workers
         self.threads_per_worker = threads_per_worker
         self.protocol = protocol
+        if protocol == "inproc":
+            listen_addr = "inproc://"
+        else:
+            listen_addr = f"{protocol}://127.0.0.1:0"
         self.scheduler = Scheduler(
-            listen_addr=f"{protocol}://" if protocol == "inproc" else None,
-            **(scheduler_kwargs or {}),
+            listen_addr=listen_addr, **(scheduler_kwargs or {})
         )
         self._worker_kwargs = worker_kwargs or {}
         self.workers: list[Worker] = []
@@ -60,6 +63,8 @@ class LocalCluster:
         kw.setdefault("nthreads", self.threads_per_worker)
         if self.protocol == "inproc":
             kw.setdefault("listen_addr", "inproc://")
+        elif self.protocol != "tcp":
+            kw.setdefault("listen_addr", f"{self.protocol}://127.0.0.1:0")
         worker = Worker(self.scheduler.address, name=name, **kw)
         await worker.start()
         self.workers.append(worker)

@@ -152,6 +152,11 @@ class PipInstall(WorkerPlugin):
 
     def __init__(self, packages: list[str], pip_options: list[str] | None = None,
                  restart_workers: bool = False):
+        if restart_workers:
+            raise NotImplementedError(
+                "restart_workers is not supported yet; restart via the "
+                "nanny (scheduler.retire_workers + Nanny.restart) instead"
+            )
         self.packages = list(packages)
         self.pip_options = list(pip_options or [])
 

@@ -1,0 +1,115 @@
+"""Native (C++) components, loaded via ctypes with on-demand compilation.
+
+The reference obtains native speed from C dependencies (msgpack, lz4,
+crick, ucx — SURVEY §2); this package holds our own equivalents.  The
+shared library builds once per machine into the package directory with
+``g++ -O2 -shared`` and every consumer has a pure-python fallback, so a
+missing toolchain degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger("distributed_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "_dtpu_native.so")
+_SOURCES = [os.path.join(_HERE, "tdigest.cpp")]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(src) > lib_mtime for src in _SOURCES)
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        *_SOURCES, "-o", _LIB_PATH,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build failed to run: %s", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning(
+            "native build failed:\n%s", proc.stderr.decode()[-2000:]
+        )
+        return False
+    return True
+
+
+def prebuild_async() -> None:
+    """Kick off the g++ build on a daemon thread (servers call this at
+    start so the first Digest() on the event loop never blocks on a
+    compile)."""
+    threading.Thread(target=load, name="dtpu-native-build", daemon=True).start()
+
+
+def load_nowait() -> ctypes.CDLL | None:
+    """The library if already built/loaded; never compiles (safe on the
+    event loop)."""
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed or _needs_build():
+            return None
+    return load()
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if _needs_build() and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("cannot load native library: %s", e)
+            _build_failed = True
+            return None
+        # signatures
+        lib.tdigest_new.restype = ctypes.c_void_p
+        lib.tdigest_new.argtypes = [ctypes.c_double]
+        lib.tdigest_free.argtypes = [ctypes.c_void_p]
+        lib.tdigest_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_double
+        ]
+        lib.tdigest_add_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64
+        ]
+        lib.tdigest_quantile.restype = ctypes.c_double
+        lib.tdigest_quantile.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.tdigest_count.restype = ctypes.c_double
+        lib.tdigest_count.argtypes = [ctypes.c_void_p]
+        lib.tdigest_min.restype = ctypes.c_double
+        lib.tdigest_min.argtypes = [ctypes.c_void_p]
+        lib.tdigest_max.restype = ctypes.c_double
+        lib.tdigest_max.argtypes = [ctypes.c_void_p]
+        lib.tdigest_serialize.restype = ctypes.c_int64
+        lib.tdigest_serialize.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64
+        ]
+        lib.tdigest_merge_serialized.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64
+        ]
+        _lib = lib
+        return _lib

@@ -207,6 +207,7 @@ class Server:
         self.periodic_callbacks: dict[str, PeriodicCallback] = {}
         self.counters: dict[str, int] = {}
         self.digests: dict[str, float] = {}
+        self.digests_tdigest: dict[str, Any] = {}
         self._startup_lock = asyncio.Lock()
         self._close_started = False
         self._event_finished = asyncio.Event()
@@ -396,7 +397,15 @@ class Server:
     # ------------------------------------------------------------- helpers
 
     def digest_metric(self, name: str, value: float) -> None:
+        """Cumulative total + streaming quantile sketch per metric
+        (reference core.py:1088; sketch = native t-digest, counter.py:40)."""
         self.digests[name] = self.digests.get(name, 0.0) + value
+        digest = self.digests_tdigest.get(name)
+        if digest is None:
+            from distributed_tpu.utils.counter import Digest
+
+            digest = self.digests_tdigest[name] = Digest()
+        digest.add(value)
 
     def __repr__(self) -> str:
         try:

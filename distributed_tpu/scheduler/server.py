@@ -169,6 +169,9 @@ class Scheduler(Server):
     # ----------------------------------------------------------- lifecycle
 
     async def start_unsafe(self) -> "Scheduler":
+        from distributed_tpu import native
+
+        native.prebuild_async()
         addr = self._listen_addr or "tcp://127.0.0.1:0"
         await self.listen(addr)
         # observability: SystemMonitor sampling + HTTP routes
@@ -946,7 +949,12 @@ class Scheduler(Server):
                                      name: str | None = None) -> dict:
         """Install a WorkerPlugin on every current and future worker
         (reference scheduler.py:7425)."""
-        name = name or f"worker-plugin-{len(self.worker_plugins)}"
+        if name is None:
+            import itertools
+
+            if not hasattr(self, "_plugin_counter"):
+                self._plugin_counter = itertools.count()
+            name = f"worker-plugin-{next(self._plugin_counter)}"
         # re-wrap: over tcp the comm already deserialized the plugin, and
         # it must cross the scheduler->worker wire pickled again
         plugin = Serialize(unwrap(plugin))
@@ -1011,28 +1019,36 @@ class Scheduler(Server):
                 projected[recipient] += ts.get_nbytes()
                 recipients.sort(key=lambda ws: projected[ws])
 
-        n_ok = 0
+        # enact concurrently, one batched gather per (sender, recipient)
+        # pair (reference _rebalance_move_data :6795 batches the same way)
+        by_pair: dict[tuple, list] = {}
         for ts, sender, recipient in moves:
             if ts.state != "memory" or sender not in ts.who_has:
                 continue
+            by_pair.setdefault((sender, recipient), []).append(ts)
+
+        async def move_batch(sender, recipient, tss) -> int:
             try:
                 resp = await self.rpc(recipient.address).gather(
-                    who_has={ts.key: [sender.address]}
+                    who_has={ts.key: [sender.address] for ts in tss}
                 )
             except (CommClosedError, OSError):
-                continue
+                return 0
             if resp.get("status") != "OK":
-                continue
-            # gather -> add-keys already registered the new replica when
-            # the stream message lands; register eagerly + drop the old one
-            if recipient not in ts.who_has:
-                s.add_replica(ts, recipient)
+                return 0
+            for ts in tss:
+                if recipient not in ts.who_has:
+                    s.add_replica(ts, recipient)
             self.send_all({}, {sender.address: [{
-                "op": "remove-replicas", "keys": [ts.key],
+                "op": "remove-replicas", "keys": [ts.key for ts in tss],
                 "stimulus_id": seq_name("rebalance"),
             }]})
-            n_ok += 1
-        return {"status": "OK", "moves": n_ok}
+            return len(tss)
+
+        counts = await asyncio.gather(
+            *(move_batch(snd, rcp, tss) for (snd, rcp), tss in by_pair.items())
+        )
+        return {"status": "OK", "moves": sum(counts)}
 
     async def get_runspec(self, key: Key = "") -> dict:
         """Fetch a task's spec + dependency keys for client-side replay

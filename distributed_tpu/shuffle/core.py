@@ -256,7 +256,9 @@ class ShuffleWorkerExtension:
             if run is None or run.run_id != run_id:
                 return
             idle = _now() - run.last_activity
-            if run.local_outputs_left <= 0 or idle >= self.RUN_TTL:
+            # idleness required even with no local outputs left: a
+            # transfer-only worker is still actively pushing shards
+            if (run.local_outputs_left <= 0 and idle >= 5.0) or idle >= self.RUN_TTL:
                 run.close()
                 del self.runs[id]
             else:

@@ -1,0 +1,141 @@
+"""Counter + Digest sketches for metrics (reference counter.py).
+
+``Digest`` records streaming samples (task latencies, transfer times,
+tick durations) and answers quantile queries — backed by the native C++
+t-digest (``distributed_tpu.native``) like the reference's optional
+crick TDigest (counter.py:7,40), with a sorted-sample fallback when the
+native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import defaultdict
+from typing import Iterable
+
+
+class Counter:
+    """Tally of discrete observations (reference counter.py:16)."""
+
+    def __init__(self):
+        self.counts: defaultdict = defaultdict(int)
+        self.n = 0
+
+    def add(self, item) -> None:
+        self.counts[item] += 1
+        self.n += 1
+
+    def update(self, items: Iterable) -> None:
+        for item in items:
+            self.add(item)
+
+    def most_common(self, k: int | None = None):
+        out = sorted(self.counts.items(), key=lambda kv: -kv[1])
+        return out if k is None else out[:k]
+
+
+class Digest:
+    """Streaming quantile sketch (reference counter.py:40)."""
+
+    def __init__(self, compression: float = 100.0, *, block_on_build: bool = False):
+        from distributed_tpu import native
+
+        # load_nowait: constructing a Digest on an event loop must never
+        # trigger a synchronous g++ compile (servers prebuild at start)
+        self._lib = native.load() if block_on_build else native.load_nowait()
+        self._handle = None
+        self._fallback: list[float] | None = None
+        self.compression = compression
+        if self._lib is not None:
+            self._handle = self._lib.tdigest_new(compression)
+        else:
+            self._fallback = []
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def add(self, x: float, weight: float = 1.0) -> None:
+        if self._handle is not None:
+            self._lib.tdigest_add(self._handle, float(x), float(weight))
+        else:
+            self._fallback.extend([float(x)] * max(1, int(weight)))
+            if len(self._fallback) > 100_000:  # bound the fallback
+                self._fallback = sorted(self._fallback)[::2]
+
+    def add_batch(self, xs) -> None:
+        if self._handle is not None:
+            import numpy as np
+
+            arr = np.ascontiguousarray(xs, dtype=np.float64)
+            self._lib.tdigest_add_batch(
+                self._handle,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                len(arr),
+            )
+        else:
+            for x in xs:
+                self.add(x)
+
+    def quantile(self, q: float) -> float:
+        if self._handle is not None:
+            return self._lib.tdigest_quantile(self._handle, float(q))
+        data = sorted(self._fallback)
+        if not data:
+            return float("nan")
+        idx = min(len(data) - 1, max(0, int(q * (len(data) - 1))))
+        return data[idx]
+
+    def count(self) -> float:
+        if self._handle is not None:
+            return self._lib.tdigest_count(self._handle)
+        return float(len(self._fallback))
+
+    def min(self) -> float:
+        if self._handle is not None:
+            return self._lib.tdigest_min(self._handle)
+        return min(self._fallback) if self._fallback else float("nan")
+
+    def max(self) -> float:
+        if self._handle is not None:
+            return self._lib.tdigest_max(self._handle)
+        return max(self._fallback) if self._fallback else float("nan")
+
+    def serialize(self) -> bytes:
+        """Centroid array as bytes, mergeable on another node."""
+        if self._handle is None:
+            import struct
+
+            data = sorted(self._fallback)[:1000]
+            return struct.pack(f"<d{len(data) * 2}d", float(len(data)),
+                               *sum(([x, 1.0] for x in data), []))
+        need = self._lib.tdigest_serialize(self._handle, None, 0)
+        buf = (ctypes.c_double * need)()
+        self._lib.tdigest_serialize(self._handle, buf, need)
+        return bytes(bytearray(buf))
+
+    def merge_serialized(self, payload: bytes) -> None:
+        n = len(payload) // 8
+        buf = (ctypes.c_double * n).from_buffer_copy(payload)
+        if self._handle is not None:
+            self._lib.tdigest_merge_serialized(self._handle, buf, n)
+        else:
+            vals = list(buf)
+            count = int(vals[0]) if vals else 0
+            for i in range(count):
+                if 2 + 2 * i < len(vals):
+                    self.add(vals[1 + 2 * i], vals[2 + 2 * i])
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None and self._lib is not None:
+            try:
+                self._lib.tdigest_free(self._handle)
+            except Exception:
+                pass
+            self._handle = None
+
+    def __repr__(self) -> str:
+        return (
+            f"<Digest n={self.count():.0f} native={self.native} "
+            f"compression={self.compression}>"
+        )

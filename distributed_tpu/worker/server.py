@@ -163,6 +163,9 @@ class Worker(Server):
     # ------------------------------------------------------------ lifecycle
 
     async def start_unsafe(self) -> "Worker":
+        from distributed_tpu import native
+
+        native.prebuild_async()
         self.loop = asyncio.get_running_loop()
         addr = self._listen_addr
         if addr is None:
@@ -601,6 +604,7 @@ class Worker(Server):
             else:
                 value = unwrap(run_spec)  # literal data baked into the graph
             stop = time()
+            self.digest_metric("compute-duration", stop - start)
             return ExecuteSuccessEvent(
                 stimulus_id=stimulus_id,
                 key=key,

@@ -1,0 +1,64 @@
+"""WebSocket comm backend tests (reference comm/tests/test_ws.py patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.comm.core import connect, listen
+
+from conftest import gen_test
+
+
+@gen_test()
+async def test_ws_comm_roundtrip():
+    received = []
+
+    async def handle(comm):
+        msg = await comm.read()
+        received.append(msg)
+        await comm.write({"echo": msg})
+
+    listener = listen("ws://127.0.0.1:0", handle)
+    await listener.start()
+    comm = await connect(listener.contact_address)
+    await comm.write({"hello": "ws", "n": 42})
+    resp = await comm.read()
+    assert resp == {"echo": {"hello": "ws", "n": 42}}
+    assert received == [{"hello": "ws", "n": 42}]
+    await comm.close()
+    listener.stop()
+
+
+@gen_test()
+async def test_ws_large_payload_fragmented():
+    """Payloads beyond one fragment survive (8 MiB fragmentation)."""
+
+    async def handle(comm):
+        msg = await comm.read()
+        await comm.write({"len": len(msg["blob"])})
+
+    listener = listen("ws://127.0.0.1:0", handle)
+    await listener.start()
+    comm = await connect(listener.contact_address)
+    from distributed_tpu.protocol.serialize import Serialize
+
+    blob = bytes(9 * 2**20)  # forces a continuation frame
+    await comm.write({"blob": Serialize(blob)})
+    resp = await comm.read()
+    assert resp == {"len": 9 * 2**20}
+    await comm.close()
+    listener.stop()
+
+
+@gen_test(timeout=90)
+async def test_cluster_over_ws():
+    """A whole cluster runs over the ws:// protocol."""
+    async with LocalCluster(n_workers=2, protocol="ws") as cluster:
+        assert cluster.scheduler_address.startswith("ws://")
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x * 3, range(10))
+            assert await asyncio.wait_for(c.gather(futs), 60) == [
+                3 * i for i in range(10)
+            ]

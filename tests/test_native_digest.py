@@ -1,0 +1,73 @@
+"""Native C++ t-digest tests (the crick-equivalent, reference counter.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_tpu import native
+from distributed_tpu.utils.counter import Counter, Digest
+
+
+def test_native_library_builds():
+    lib = native.load()
+    assert lib is not None, "g++ is available here; the native build must work"
+
+
+def test_digest_quantiles_accurate():
+    d = Digest()
+    assert d.native
+    rng = np.random.default_rng(0)
+    samples = rng.normal(100.0, 15.0, 50_000)
+    d.add_batch(samples)
+    assert d.count() == 50_000
+    for q in (0.01, 0.25, 0.5, 0.75, 0.99):
+        exact = float(np.quantile(samples, q))
+        est = d.quantile(q)
+        # t-digest is tight at the tails and center
+        assert abs(est - exact) < 1.0, (q, est, exact)
+    assert d.min() == samples.min()
+    assert d.max() == samples.max()
+
+
+def test_digest_serialize_merge():
+    rng = np.random.default_rng(1)
+    a, b = Digest(), Digest()
+    xs = rng.uniform(0, 100, 10_000)
+    ys = rng.uniform(100, 200, 10_000)
+    a.add_batch(xs)
+    b.add_batch(ys)
+    merged = Digest()
+    merged.merge_serialized(a.serialize())
+    merged.merge_serialized(b.serialize())
+    all_samples = np.concatenate([xs, ys])
+    est = merged.quantile(0.5)
+    exact = float(np.quantile(all_samples, 0.5))
+    assert abs(est - exact) < 3.0, (est, exact)
+
+
+def test_digest_weighted_add():
+    d = Digest()
+    d.add(10.0, weight=3)
+    d.add(20.0, weight=1)
+    assert d.count() == 4
+    assert d.quantile(0.25) <= 15
+
+
+def test_counter():
+    c = Counter()
+    c.update(["a", "b", "a", "a"])
+    assert c.most_common(1) == [("a", 3)]
+    assert c.n == 4
+
+
+def test_server_digest_metric_uses_tdigest():
+    from distributed_tpu.rpc.core import Server
+
+    s = Server()
+    for v in (0.1, 0.2, 0.3, 0.4):
+        s.digest_metric("latency", v)
+    assert abs(s.digests["latency"] - 1.0) < 1e-9  # cumulative total
+    sketch = s.digests_tdigest["latency"]
+    assert sketch.count() == 4
+    assert 0.1 <= sketch.quantile(0.5) <= 0.4
