@@ -383,6 +383,11 @@ class Client:
         }
         annotations: dict[Key, dict] = dict(annotations_by_key or {})
         ann: dict[str, Any] = {}
+        from distributed_tpu.diagnostics.spans import current_span
+
+        active_span = current_span()
+        if active_span:
+            ann["span"] = list(active_span)
         if workers is not None:
             ann["workers"] = workers
             if allow_other_workers:
@@ -776,6 +781,42 @@ class Client:
                               count: int | None = None) -> list:
         assert self.scheduler is not None
         return await self.scheduler.get_task_stream(start=start, count=count)
+
+    async def get_spans(self) -> list:
+        assert self.scheduler is not None
+        return await self.scheduler.get_spans()
+
+    async def get_versions(self, check: bool = False) -> dict:
+        """Version info for scheduler, workers, and this client
+        (reference client.py get_versions)."""
+        from distributed_tpu.versions import get_versions, version_mismatches
+
+        assert self.scheduler is not None
+        out = {
+            "client": get_versions(),
+            "scheduler": await self.scheduler.versions(),
+            "workers": await self.scheduler.worker_versions(),
+        }
+        mismatches = version_mismatches(out)
+        if mismatches and check:
+            raise ValueError(f"version mismatches: {mismatches}")
+        out["mismatches"] = mismatches
+        return out
+
+    async def benchmark_hardware(self) -> dict:
+        """Memory/disk bandwidth micro-benchmarks on every worker
+        (reference scheduler.py:7590)."""
+        assert self.scheduler is not None
+        return await self.scheduler.benchmark_hardware()
+
+    async def performance_report(self, filename: str = "dtpu-report.html"
+                                 ) -> str:
+        """Self-contained HTML snapshot (reference scheduler.py:8077)."""
+        assert self.scheduler is not None
+        html = await self.scheduler.performance_report_html()
+        with open(filename, "w") as f:
+            f.write(html)
+        return filename
 
     async def profile(self, workers: list[str] | None = None,
                       start: float | None = None) -> dict:

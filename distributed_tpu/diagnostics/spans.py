@@ -1,0 +1,128 @@
+"""Spans: tag workloads and aggregate their task statistics
+(reference spans.py).
+
+``span("workflow")`` on the client annotates every task submitted inside
+the context (reference spans.py:31 does it via dask annotations); the
+scheduler-side ``SpansSchedulerExtension`` builds a tree of Span records
+aggregating task states, compute time, and bytes as transitions flow
+through the plugin hook (reference SpansSchedulerExtension :450,483).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import uuid
+from collections import defaultdict
+from typing import TYPE_CHECKING, Any, Iterator
+
+from distributed_tpu.utils.misc import time
+
+if TYPE_CHECKING:
+    from distributed_tpu.scheduler.server import Scheduler
+
+_current_span: contextvars.ContextVar[tuple[str, ...] | None] = (
+    contextvars.ContextVar("dtpu_span", default=None)
+)
+
+
+@contextlib.contextmanager
+def span(*names: str) -> Iterator[str]:
+    """Tag tasks submitted in this context (reference spans.py:31)."""
+    parent = _current_span.get() or ()
+    full = parent + names
+    token = _current_span.set(full)
+    try:
+        yield "/".join(full)
+    finally:
+        _current_span.reset(token)
+
+
+def current_span() -> tuple[str, ...] | None:
+    return _current_span.get()
+
+
+class Span:
+    """Aggregated stats for one span node (reference spans.py:74)."""
+
+    __slots__ = ("id", "name", "parent", "children", "states", "n_tasks",
+                 "compute_seconds", "nbytes", "start", "stop")
+
+    def __init__(self, name: tuple[str, ...], parent: "Span | None" = None):
+        self.id = f"span-{uuid.uuid4().hex[:12]}"
+        self.name = name
+        self.parent = parent
+        self.children: list[Span] = []
+        self.states: defaultdict[str, int] = defaultdict(int)
+        self.n_tasks = 0
+        self.compute_seconds = 0.0
+        self.nbytes = 0
+        self.start = 0.0
+        self.stop = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "name": list(self.name),
+            "n_tasks": self.n_tasks,
+            "states": dict(self.states),
+            "compute_seconds": self.compute_seconds,
+            "nbytes": self.nbytes,
+            "start": self.start,
+            "stop": self.stop,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class SpansSchedulerExtension:
+    """Builds the span tree from task annotations + transitions
+    (reference spans.py:450)."""
+
+    def __init__(self, scheduler: "Scheduler"):
+        self.scheduler = scheduler
+        self.spans: dict[tuple[str, ...], Span] = {}
+        self.key_span: dict[str, Span] = {}
+        scheduler.state.plugins["spans"] = self
+        scheduler.handlers["get_spans"] = self.get_spans
+
+    def _get_or_create(self, name: tuple[str, ...]) -> Span:
+        sp = self.spans.get(name)
+        if sp is None:
+            parent = self._get_or_create(name[:-1]) if len(name) > 1 else None
+            sp = self.spans[name] = Span(name, parent)
+            if parent is not None:
+                parent.children.append(sp)
+        return sp
+
+    def transition(self, key: str, start: str, finish: str, *args: Any,
+                   **kwargs: Any) -> None:
+        sp = self.key_span.get(key)
+        if sp is None:
+            ts = self.scheduler.state.tasks.get(key)
+            if ts is None or not ts.annotations:
+                return
+            name = ts.annotations.get("span")
+            if not name:
+                return
+            sp = self._get_or_create(tuple(name))
+            self.key_span[key] = sp
+            sp.n_tasks += 1
+            if not sp.start:
+                sp.start = time()
+        sp.states[finish] += 1
+        if finish == "memory" and start == "processing":
+            for ss in kwargs.get("startstops") or ():
+                if ss.get("action") == "compute":
+                    sp.compute_seconds += ss["stop"] - ss["start"]
+            nbytes = kwargs.get("nbytes")
+            if nbytes:
+                sp.nbytes += nbytes
+            sp.stop = time()
+        if finish == "forgotten":
+            self.key_span.pop(key, None)
+
+    async def get_spans(self) -> list[dict]:
+        return [
+            sp.to_dict() for name, sp in self.spans.items() if len(name) == 1
+        ]

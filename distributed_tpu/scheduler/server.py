@@ -125,6 +125,10 @@ class Scheduler(Server):
             "unregister_worker_plugin": self.unregister_worker_plugin,
             "get_cluster_state": self.get_cluster_state,
             "get_runspec": self.get_runspec,
+            "versions": self.versions,
+            "worker_versions": self.worker_versions,
+            "benchmark_hardware": self.benchmark_hardware,
+            "performance_report_html": self.performance_report_html,
         }
         stream_handlers = {
             # from workers
@@ -154,9 +158,11 @@ class Scheduler(Server):
         for name, ext_cls in extensions.items():
             self.extensions[name] = ext_cls(self)
         self.state.extensions = self.extensions
+        from distributed_tpu.diagnostics.spans import SpansSchedulerExtension
         from distributed_tpu.diagnostics.task_stream import TaskStreamPlugin
 
         self.task_stream = TaskStreamPlugin(self)
+        self.spans = SpansSchedulerExtension(self)
         self._topic_subscribers: dict[str, set[str]] = {}
         self.state.events_subscriber_hook = self._fan_out_event
         self.worker_plugins: dict[str, Any] = {}  # shipped to joining workers
@@ -300,6 +306,8 @@ class Scheduler(Server):
             resources=kwargs.get("resources"),
             server_id=kwargs.get("server_id"),
         )
+        if kwargs.get("versions"):
+            ws.extra["versions"] = kwargs["versions"]
         self._last_worker_seen[address] = time()
         logger.info("register worker %s (%d threads)", address, ws.nthreads)
 
@@ -1049,6 +1057,61 @@ class Scheduler(Server):
             *(move_batch(snd, rcp, tss) for (snd, rcp), tss in by_pair.items())
         )
         return {"status": "OK", "moves": sum(counts)}
+
+    async def versions(self) -> dict:
+        from distributed_tpu.versions import get_versions
+
+        return get_versions()
+
+    async def worker_versions(self) -> dict:
+        return {
+            addr: ws.extra.get("versions", {})
+            for addr, ws in self.state.workers.items()
+        }
+
+    async def benchmark_hardware(self) -> dict:
+        """Memory/disk micro-benchmarks on workers (reference :7590)."""
+        resp = await self.broadcast(msg={"op": "benchmark_hardware"})
+        return {
+            a: unwrap(v.get("result")) if isinstance(v, dict) else v
+            for a, v in resp.items()
+        }
+
+    async def performance_report_html(self) -> str:
+        """Self-contained HTML snapshot (reference scheduler.py:8077)."""
+        import html as _html
+        import json as _json
+
+        s = self.state
+        counts = self._counts_json()
+        stream = self.task_stream.collect(count=2000)
+        rows = "".join(
+            f"<tr><td>{_html.escape(addr)}</td><td>{ws.nthreads}</td>"
+            f"<td>{len(ws.has_what)}</td><td>{ws.nbytes}</td>"
+            f"<td>{ws.occupancy:.2f}</td></tr>"
+            for addr, ws in s.workers.items()
+        )
+        spans = [sp for sp in self.spans.spans.values() if len(sp.name) == 1]
+        span_rows = "".join(
+            f"<tr><td>{_html.escape('/'.join(sp.name))}</td>"
+            f"<td>{sp.n_tasks}</td><td>{sp.compute_seconds:.3f}</td>"
+            f"<td>{sp.nbytes}</td></tr>"
+            for sp in spans
+        )
+        return f"""<!doctype html><html><head><meta charset="utf-8">
+<title>distributed_tpu performance report</title></head><body>
+<h1>distributed_tpu performance report</h1>
+<h2>Cluster</h2>
+<pre>{_html.escape(_json.dumps(counts, indent=1))}</pre>
+<h2>Workers</h2>
+<table border="1"><tr><th>address</th><th>threads</th><th>stored</th>
+<th>bytes</th><th>occupancy</th></tr>{rows}</table>
+<h2>Spans</h2>
+<table border="1"><tr><th>span</th><th>tasks</th><th>compute s</th>
+<th>bytes</th></tr>{span_rows}</table>
+<h2>Task stream (last {len(stream)})</h2>
+<pre>{_html.escape(_json.dumps(stream[-200:], indent=0, default=str))}</pre>
+</body></html>"""
 
     async def get_runspec(self, key: Key = "") -> dict:
         """Fetch a task's spec + dependency keys for client-side replay

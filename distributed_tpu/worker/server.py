@@ -89,10 +89,15 @@ class Worker(Server):
         self._listen_addr = listen_addr
         data = None
         if memory_limit:
+            from distributed_tpu.utils.diskutils import WorkSpace
             from distributed_tpu.worker.spill import SpillBuffer
 
             mem_cfg = config.get("worker.memory")
-            data = SpillBuffer(target=int(mem_cfg["target"] * memory_limit))
+            self._work_dir = WorkSpace().new_work_dir(prefix="spill")
+            data = SpillBuffer(
+                self._work_dir.path,
+                target=int(mem_cfg["target"] * memory_limit),
+            )
         self.state = WorkerState(
             nthreads=self.nthreads,
             resources=resources,
@@ -126,6 +131,8 @@ class Worker(Server):
             "actor_execute": self.actor_execute,
             "actor_attribute": self.actor_attribute,
             "profile": self.get_profile,
+            "versions": self.get_versions,
+            "benchmark_hardware": self.benchmark_hardware_handler,
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
@@ -206,6 +213,8 @@ class Worker(Server):
     async def _register_with_scheduler(self) -> None:
         """Handshake + dual stream with the scheduler (reference worker.py:1164)."""
         comm = await connect(self.scheduler_addr)
+        from distributed_tpu.versions import get_versions
+
         await comm.write(
             {
                 "op": "register-worker",
@@ -215,6 +224,7 @@ class Worker(Server):
                 "memory_limit": self.memory_limit,
                 "resources": self.state.total_resources,
                 "server_id": self.id,
+                "versions": get_versions(),
                 "reply": False,
             }
         )
@@ -409,6 +419,32 @@ class Worker(Server):
             return {"status": "OK", "result": Serialize(getattr(instance, attribute))}
         except Exception as e:
             return error_message(e)
+
+    async def get_versions(self) -> dict:
+        from distributed_tpu.versions import get_versions
+
+        return get_versions()
+
+    async def benchmark_hardware_handler(self) -> dict:
+        """Tiny memory/disk bandwidth probes (reference worker benchmarks)."""
+        import tempfile
+
+        def bench() -> dict:
+            out: dict = {}
+            data = bytearray(64 * 2**20)
+            t0 = time()
+            for _ in range(4):
+                bytes(data)  # memcpy
+            out["memory_copy_bps"] = 4 * len(data) / max(time() - t0, 1e-9)
+            with tempfile.NamedTemporaryFile(delete=True) as f:
+                t0 = time()
+                f.write(data)
+                f.flush()
+                out["disk_write_bps"] = len(data) / max(time() - t0, 1e-9)
+            return out
+
+        result = await asyncio.get_running_loop().run_in_executor(None, bench)
+        return {"status": "OK", "result": Serialize(result)}
 
     async def get_profile(self, start: float | None = None) -> Any:
         """Sampled call tree (reference worker.py:2449)."""

@@ -1,0 +1,108 @@
+"""Spans, versions handshake, performance report, workspace, hardware
+bench tests (reference test_spans, test_versions patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.diagnostics.spans import span
+from distributed_tpu.utils.diskutils import WorkSpace
+
+from conftest import gen_test
+
+
+async def new_cluster(n_workers=2, **kwargs):
+    cluster = LocalCluster(
+        n_workers=n_workers,
+        scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+        **kwargs,
+    )
+    await cluster._start()
+    return cluster
+
+
+@gen_test()
+async def test_spans_aggregate_tasks():
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            with span("etl"):
+                futs = c.map(lambda x: x + 1, range(6), pure=False)
+                await c.gather(futs)
+                with span("load"):
+                    f2 = c.submit(sum, futs)
+                    await f2.result()
+            spans = await c.get_spans()
+            assert len(spans) == 1
+            etl = spans[0]
+            assert etl["name"] == ["etl"]
+            assert etl["n_tasks"] == 6
+            assert etl["states"]["memory"] >= 6
+            assert etl["compute_seconds"] >= 0
+            assert len(etl["children"]) == 1
+            assert etl["children"][0]["name"] == ["etl", "load"]
+            assert etl["children"][0]["n_tasks"] == 1
+
+
+@gen_test()
+async def test_untagged_tasks_have_no_span():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.submit(lambda: 1).result()
+            assert await c.get_spans() == []
+
+
+@gen_test()
+async def test_versions_handshake():
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            info = await c.get_versions()
+            assert info["client"]["distributed_tpu"]
+            assert info["scheduler"]["python"]
+            assert len(info["workers"]) == 2
+            for v in info["workers"].values():
+                assert v["numpy"]
+            # same process everywhere: no mismatches
+            assert info["mismatches"] == {}
+
+
+@gen_test(timeout=90)
+async def test_benchmark_hardware():
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            out = await c.benchmark_hardware()
+            assert len(out) == 1
+            bench = next(iter(out.values()))
+            assert bench["memory_copy_bps"] > 1e6
+            assert bench["disk_write_bps"] > 1e5
+
+
+@gen_test()
+async def test_performance_report(tmp_path=None):
+    import tempfile
+
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            with span("report-span"):
+                futs = c.map(lambda x: x * 2, range(5), pure=False)
+                await c.gather(futs)
+            path = os.path.join(tempfile.mkdtemp(), "report.html")
+            out = await c.performance_report(path)
+            html = open(out).read()
+            assert "distributed_tpu performance report" in html
+            assert "report-span" in html
+            assert "workers" in html.lower()
+
+
+def test_workspace_purges_stale_dirs(tmp_path):
+    ws = WorkSpace(str(tmp_path))
+    d = ws.new_work_dir(prefix="w")
+    assert os.path.isdir(d.path)
+    # fake a dead owner
+    with open(d.path + ".lock", "w") as f:
+        f.write("999999999")
+    WorkSpace(str(tmp_path))  # re-scan purges it
+    assert not os.path.exists(d.path)
