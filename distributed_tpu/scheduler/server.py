@@ -25,7 +25,7 @@ from distributed_tpu.rpc.core import (
     Status,
     error_message,
 )
-from distributed_tpu.scheduler.state import SchedulerState, WorkerState
+from distributed_tpu.scheduler.state import SchedulerState, WorkerState, _merge_msgs
 from distributed_tpu.utils.comm import gather_from_workers, scatter_to_workers
 from distributed_tpu.utils.misc import seq_name, time
 
@@ -311,10 +311,14 @@ class Scheduler(Server):
         self._last_worker_seen[address] = time()
         logger.info("register worker %s (%d threads)", address, ws.nthreads)
 
+        # publish the (unstarted, buffering) BatchedSend before any await so
+        # concurrent send_all never drops messages for this worker, but only
+        # start its flush loop AFTER the registration reply is on the wire —
+        # otherwise a flushed batch could precede the handshake response
         bs = BatchedSend(interval=0.002)
-        bs.start(comm)
         self.stream_comms[address] = bs
         await comm.write({"status": "OK", "time": time()})
+        bs.start(comm)
 
         stimulus_id = seq_name("add-worker")
         recs = self.state.bulk_schedule_unrunnable_after_adding_worker(ws)
@@ -403,8 +407,11 @@ class Scheduler(Server):
 
     async def check_idle(self) -> None:
         s = self.state
+        # task activity only — a connected-but-inactive client must not
+        # keep an idle cluster alive forever (reference idle-timeout
+        # semantics, scheduler.py:8326)
         busy = any(ws.processing for ws in s.workers.values()) or s.queued or s.unrunnable
-        if busy or s.clients:
+        if busy:
             self.idle_since = None
             return
         if self.idle_since is None:
@@ -420,11 +427,14 @@ class Scheduler(Server):
         (reference scheduler.py:5550)."""
         logger.info("register client %s", client)
         self.state.add_client_state(client)
+        # same ordering as add_worker: publish the buffering BatchedSend
+        # before any await (no dropped reports), start it only after the
+        # handshake reply (no batch ahead of the handshake)
         bs = BatchedSend(interval=0.002)
-        bs.start(comm)
         self.client_comms[client] = bs
         await comm.write({"status": "OK", "time": time(),
                           "id": self.id, "type": type(self).__name__})
+        bs.start(comm)
         try:
             await self.handle_stream(comm, extra={"client": client})
         finally:
@@ -706,10 +716,21 @@ class Scheduler(Server):
         who_has = await scatter_to_workers(targets, data, rpc=self.rpc)
         from distributed_tpu.utils.sizeof import sizeof
 
+        stimulus_id = seq_name("scatter")
         for key, holders in who_has.items():
+            # a holder may have left during scatter_to_workers: only live
+            # workers count, and the memory transition needs a live one
+            holders = [a for a in holders if a in self.state.workers]
+            if not holders:
+                logger.warning("scatter: all holders of %r left; data lost", key)
+                continue
             ts = self.state.tasks.get(key)
             if ts is None:
                 ts = self.state.new_task(key, None, "released")
+            if client is not None:
+                # register the client's interest BEFORE entering memory via
+                # the engine, or the no-waiters/no-wants GC releases the key
+                self.state.client_desires_keys([key], client)
             if ts.state not in ("released", "memory"):
                 # key collides with a task mid-flight: leave the scheduler
                 # state machine alone (the worker copy is surplus data)
@@ -717,18 +738,27 @@ class Scheduler(Server):
                     "scatter ignoring key %r already in state %r", key, ts.state
                 )
                 continue
-            ts.state = "memory"
             if ts.priority is None:
                 ts.priority = (0, 0, 0)
-            self.state.update_nbytes(ts, sizeof(data[key]))
-            for addr in holders:
+            if ts.state == "released" and holders:
+                # through the engine so accounting stays consistent and
+                # waiting dependents are recommended onward
+                recs, cmsgs, wmsgs = self.state._transition(
+                    key, "memory", stimulus_id,
+                    worker=holders[0], nbytes=sizeof(data[key]),
+                )
+                cm2, wm2 = self.state.transitions(recs, stimulus_id)
+                self.send_all(_merge_msgs(cmsgs, cm2), _merge_msgs(wmsgs, wm2))
+                extra = holders[1:]
+            else:
+                self.state.update_nbytes(ts, sizeof(data[key]))
+                extra = holders
+            for addr in extra:
                 ws = self.state.workers.get(addr)
                 if ws is not None:
                     self.state.add_replica(ts, ws)
         if broadcast:
             await self.replicate(keys=list(who_has), n=len(targets) if broadcast is True else broadcast)
-        if client is not None:
-            self.state.client_desires_keys(list(who_has), client)
         return list(who_has)
 
     async def replicate(self, keys: Iterable[Key] = (), n: int | None = None,

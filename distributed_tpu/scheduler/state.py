@@ -416,6 +416,7 @@ class SchedulerState:
             ("erred", "released"): self._transition_erred_released,
             ("memory", "released"): self._transition_memory_released,
             ("released", "erred"): self._transition_released_erred,
+            ("released", "memory"): self._transition_released_memory,
         }
 
         # hot-path config cached at init (reference scheduler.py:1756-1791)
@@ -718,6 +719,30 @@ class SchedulerState:
         ts.state = "memory"
         ts.type = typename or type
         self._count_transition(ts, "waiting", "memory")
+        self._notify_waiters_task_in_memory(ts, recommendations, client_msgs)
+        return recommendations, client_msgs, {}
+
+    def _transition_released_memory(
+        self, key: Key, stimulus_id: str, *, nbytes: int | None = None,
+        typename: str | None = None, worker: str = "", **kwargs: Any,
+    ) -> tuple[dict, dict, dict]:
+        """Out-of-band data landed (scatter): enter memory through the
+        engine so prefix/state accounting stays consistent and waiting
+        dependents get recommendations (reference scatter semantics,
+        scheduler.py:6103)."""
+        ts = self.tasks[key]
+        ws = self.workers.get(worker)
+        if ws is None:
+            return {}, {}, {}
+        if nbytes is not None:
+            self.update_nbytes(ts, nbytes)
+        self.add_replica(ts, ws)
+        ts.state = "memory"
+        if typename:
+            ts.type = typename
+        self._count_transition(ts, "released", "memory")
+        recommendations: dict[Key, str] = {}
+        client_msgs: dict = {}
         self._notify_waiters_task_in_memory(ts, recommendations, client_msgs)
         return recommendations, client_msgs, {}
 

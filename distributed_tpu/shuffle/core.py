@@ -81,6 +81,7 @@ class ShuffleRun:
         self.closed = False
         self.bytes_received = 0
         self.transfers_done: set[int] = set()
+        self.outputs_served: set[int] = set()
         self.local_outputs_left = sum(
             1 for addr in spec.worker_for.values() if addr == worker.address
         )
@@ -170,6 +171,16 @@ class ShuffleRun:
         self.touch()
         await asyncio.wait_for(self.inputs_done.wait(), timeout)
         self.touch()
+        if j in self.outputs_served:
+            # the bucket was consumed by a previous serve: a recomputed
+            # unpack must not silently get an empty partition — fail the
+            # run so the scheduler restarts it under a new run_id epoch
+            # (reference fails stale/duplicate fetches the same way)
+            raise ShuffleClosedError(
+                f"{self.id}: output partition {j} already served; "
+                f"restart required"
+            )
+        self.outputs_served.add(j)
         bucket = self.shards.pop(j, {})
         self.local_outputs_left -= 1
         if self.local_outputs_left <= 0:

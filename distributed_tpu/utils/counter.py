@@ -59,7 +59,7 @@ class Digest:
         if self._handle is not None:
             self._lib.tdigest_add(self._handle, float(x), float(weight))
         else:
-            self._fallback.extend([float(x)] * max(1, int(weight)))
+            self._fallback.extend([float(x)] * max(1, round(weight)))
             if len(self._fallback) > 100_000:  # bound the fallback
                 self._fallback = sorted(self._fallback)[::2]
 
@@ -106,9 +106,17 @@ class Digest:
         if self._handle is None:
             import struct
 
-            data = sorted(self._fallback)[:1000]
+            # uniform stride over the sorted samples, with aggregate
+            # weights, so the merged distribution keeps both tails
+            # instead of only the 1000 smallest values
+            full = sorted(self._fallback)
+            stride = -(-len(full) // 1000)  # ceil: at most 1000 samples
+            data = full[::stride] if full else []
+            if data and data[-1] != full[-1]:
+                data.append(full[-1])  # keep the maximum (upper tail)
+            weight = len(full) / len(data) if data else 1.0
             return struct.pack(f"<d{len(data) * 2}d", float(len(data)),
-                               *sum(([x, 1.0] for x in data), []))
+                               *sum(([x, weight] for x in data), []))
         need = self._lib.tdigest_serialize(self._handle, None, 0)
         buf = (ctypes.c_double * need)()
         self._lib.tdigest_serialize(self._handle, buf, need)
