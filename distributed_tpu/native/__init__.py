@@ -19,7 +19,10 @@ logger = logging.getLogger("distributed_tpu.native")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "_dtpu_native.so")
-_SOURCES = [os.path.join(_HERE, "tdigest.cpp")]
+_SOURCES = [
+    os.path.join(_HERE, "tdigest.cpp"),
+    os.path.join(_HERE, "graphpack.cpp"),
+]
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -110,6 +113,16 @@ def load() -> ctypes.CDLL | None:
         ]
         lib.tdigest_merge_serialized.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64
+        ]
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        _f32p = ctypes.POINTER(ctypes.c_float)
+        lib.graphpack_full.restype = ctypes.c_int64
+        lib.graphpack_full.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            _f32p, _f32p, _i32p, _i32p,
+            ctypes.c_double,
+            _i32p, _i32p, _i32p,
+            _f32p, _i32p, _f32p, _f32p,
         ]
         _lib = lib
         return _lib

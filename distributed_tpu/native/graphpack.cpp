@@ -1,0 +1,151 @@
+// graphpack: O(T+E) host-side preprocessing of a task graph for the
+// level-synchronous device placement engine (ops/leveled.py).
+//
+// Replaces the numpy lexsort/ufunc pack (825 ms at 1M tasks) with a single
+// C++ pass (~15 ms), and additionally computes topological levels so the
+// device kernel needs NO dependency edges and NO indegree bookkeeping at
+// all: each wave is a contiguous slice of the level-sorted task arrays.
+//
+// Reference semantics mirrored (not copied): the heaviest-dependency
+// choice corresponds to decide_worker's candidate set from who_has
+// (distributed/scheduler.py:8550) and dep_total to worker_objective's
+// missing-bytes term (distributed/scheduler.py:3131).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Returns the number of levels (>=0) on success, -1 if the graph has a
+// cycle (some tasks never became ready).  All output buffers are
+// caller-allocated with length T (offsets: T+1).
+//
+//   level[t]     topological level of task t (0 = no dependencies)
+//   perm[i]      original index of the i-th task in (level, index) order
+//   heavy[t]     dependency of t with the largest out_bytes (-1 if none;
+//                ties broken toward the lowest source index)
+//   dep_total[t] sum of out_bytes over t's dependencies
+//   offsets[l]   start of level l in perm; offsets[n_levels] == T
+int64_t graphpack(
+    int64_t T, int64_t E,
+    const float* out_bytes,
+    const int32_t* src, const int32_t* dst,
+    int32_t* level, int32_t* perm, int32_t* heavy, float* dep_total,
+    int32_t* offsets)
+{
+    if (T <= 0) return 0;
+
+    std::vector<int32_t> indeg(T, 0);
+    std::vector<float> heavy_bytes(T, -1.0f);
+    for (int64_t t = 0; t < T; ++t) {
+        heavy[t] = -1;
+        dep_total[t] = 0.0f;
+        level[t] = -1;
+    }
+
+    // one edge pass: indegree, heavy dep, dep byte totals
+    for (int64_t e = 0; e < E; ++e) {
+        int32_t s = src[e], d = dst[e];
+        if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
+        indeg[d] += 1;
+        float b = out_bytes[s];
+        dep_total[d] += b;
+        if (b > heavy_bytes[d] || (b == heavy_bytes[d] && s < heavy[d])) {
+            heavy_bytes[d] = b;
+            heavy[d] = s;
+        }
+    }
+
+    // CSR out-adjacency (counting sort of edges by src)
+    std::vector<int64_t> outptr(T + 1, 0);
+    for (int64_t e = 0; e < E; ++e) {
+        int32_t s = src[e], d = dst[e];
+        if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
+        outptr[s + 1] += 1;
+    }
+    for (int64_t t = 0; t < T; ++t) outptr[t + 1] += outptr[t];
+    std::vector<int32_t> outadj(outptr[T]);
+    {
+        std::vector<int64_t> fill(outptr.begin(), outptr.end() - 1);
+        for (int64_t e = 0; e < E; ++e) {
+            int32_t s = src[e], d = dst[e];
+            if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
+            outadj[fill[s]++] = d;
+        }
+    }
+
+    // Kahn's algorithm, level-synchronous; frontier queues reused
+    std::vector<int32_t> frontier, next;
+    frontier.reserve(T);
+    next.reserve(T);
+    for (int64_t t = 0; t < T; ++t)
+        if (indeg[t] == 0) frontier.push_back((int32_t)t);
+
+    int64_t placed = 0, n_levels = 0, pi = 0;
+    while (!frontier.empty()) {
+        offsets[n_levels] = (int32_t)pi;
+        // frontier preserves ascending original index within a level:
+        // it is filled either by the ordered initial scan or by the
+        // ordered sweep below, keeping the (level, index) sort stable
+        for (int32_t t : frontier) {
+            level[t] = (int32_t)n_levels;
+            perm[pi++] = t;
+        }
+        placed += (int64_t)frontier.size();
+        next.clear();
+        for (int32_t t : frontier)
+            for (int64_t j = outptr[t]; j < outptr[t + 1]; ++j)
+                if (--indeg[outadj[j]] == 0) next.push_back(outadj[j]);
+        // keep within-level order sorted by original index (stable
+        // priority order).  next is built producer-by-producer so it can
+        // be out of order; an insertion-friendly counting approach would
+        // be O(T) per level, so sort the (typically small) frontier.
+        std::sort(next.begin(), next.end());
+        frontier.swap(next);
+        ++n_levels;
+    }
+    offsets[n_levels] = (int32_t)pi;
+    if (placed != T) return -1;  // cycle
+    return n_levels;
+}
+
+// Full pack: graphpack plus the level-sorted, remapped per-task arrays
+// the device kernel consumes, so the hot path does no numpy fancy
+// indexing at all.  Outputs (length T, caller-allocated):
+//   dur_s[i]   duration of sorted task i
+//   heavy_s[i] heaviest dep of sorted task i as a SORTED index (-1 none)
+//   xp_s[i]    transfer seconds if co-located with the heavy dep
+//   xa_s[i]    transfer seconds if placed anywhere else
+// plus level/perm/offsets as in graphpack.
+int64_t graphpack_full(
+    int64_t T, int64_t E,
+    const float* durations, const float* out_bytes,
+    const int32_t* src, const int32_t* dst,
+    double inv_bandwidth,
+    int32_t* level, int32_t* perm, int32_t* offsets,
+    float* dur_s, int32_t* heavy_s, float* xp_s, float* xa_s)
+{
+    std::vector<int32_t> heavy(T);
+    std::vector<float> dep_total(T);
+    int64_t n_levels = graphpack(T, E, out_bytes, src, dst,
+                                 level, perm, heavy.data(), dep_total.data(),
+                                 offsets);
+    if (n_levels < 0) return -1;
+    std::vector<int32_t> inv(T);
+    for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
+    float ibw = (float)inv_bandwidth;
+    for (int64_t i = 0; i < T; ++i) {
+        int32_t t = perm[i];
+        dur_s[i] = durations[t];
+        int32_t h = heavy[t];
+        heavy_s[i] = h >= 0 ? inv[h] : -1;
+        float hb = h >= 0 ? out_bytes[h] : 0.0f;
+        xa_s[i] = dep_total[t] * ibw;
+        xp_s[i] = (dep_total[t] - hb) * ibw;
+    }
+    return n_levels;
+}
+
+}  // extern "C"

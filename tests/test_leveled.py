@@ -1,0 +1,216 @@
+"""Level-synchronous placement engine (ops/leveled.py): C++ pack parity
+with the numpy fallback, placement invariants, policy behaviors.
+
+Mirrors the reference's placement semantics tests in spirit
+(decide_worker locality + rootish spreading, scheduler.py:8550,2135);
+the engine itself is validated against host oracles, not the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_tpu.ops.leveled import (
+    SMALL_WAVE,
+    _pack_numpy,
+    _plan_runs,
+    pack_graph,
+    place_graph_leveled,
+    validate_leveled,
+)
+
+BW = 100e6
+
+
+def random_dag(rng, n, max_deps=2):
+    durations = rng.uniform(0.01, 1.0, n).astype(np.float32)
+    out_bytes = rng.uniform(1e3, 1e7, n).astype(np.float32)
+    n_deps = rng.integers(0, max_deps + 1, n)
+    n_deps[0] = 0
+    dst = np.repeat(np.arange(n), n_deps).astype(np.int32)
+    src = (rng.random(len(dst)) * np.maximum(dst, 1)).astype(np.int32)
+    return durations, out_bytes, src, dst
+
+
+def workers(W, threads=2, stopped=()):
+    running = np.ones(W, bool)
+    for s in stopped:
+        running[s] = False
+    return (
+        np.full(W, threads, np.int32),
+        np.zeros(W, np.float32),
+        running,
+    )
+
+
+# ------------------------------------------------------------------ pack
+
+
+def test_pack_native_matches_numpy_fallback():
+    rng = np.random.default_rng(1)
+    durations, out_bytes, src, dst = random_dag(rng, 3000)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    lv, perm, heavy, dep_total, offsets, L = _pack_numpy(
+        durations, out_bytes, src.astype(np.int64), dst.astype(np.int64)
+    )
+    assert packed.n_levels == L
+    np.testing.assert_array_equal(packed.level, lv)
+    np.testing.assert_array_equal(packed.perm, perm)
+    np.testing.assert_array_equal(packed.offsets, offsets)
+    inv = np.empty(3000, np.int32)
+    inv[perm] = np.arange(3000)
+    hp = heavy[perm]
+    np.testing.assert_array_equal(
+        packed.heavy_s, np.where(hp >= 0, inv[np.maximum(hp, 0)], -1)
+    )
+    np.testing.assert_allclose(
+        packed.xfer_all_s, dep_total[perm] / BW, rtol=1e-5
+    )
+    np.testing.assert_array_equal(packed.duration_s, durations[perm])
+
+
+def test_pack_levels_are_topological():
+    rng = np.random.default_rng(2)
+    _, _, src, dst = random_dag(rng, 2000)
+    packed = pack_graph(*random_dag(np.random.default_rng(2), 2000))
+    lv = packed.level
+    assert (lv[dst] > lv[src]).all()
+    # level 0 == tasks with no deps
+    has_dep = np.zeros(2000, bool)
+    has_dep[dst] = True
+    np.testing.assert_array_equal(lv == 0, ~has_dep)
+
+
+def test_pack_cycle_detected():
+    durations = np.ones(3, np.float32)
+    out_bytes = np.ones(3, np.float32)
+    src = np.asarray([0, 1, 2], np.int32)
+    dst = np.asarray([1, 2, 0], np.int32)
+    with pytest.raises(ValueError, match="cycle"):
+        pack_graph(durations, out_bytes, src, dst)
+    with pytest.raises(ValueError, match="cycle"):
+        _pack_numpy(durations, out_bytes, src.astype(np.int64),
+                    dst.astype(np.int64))
+
+
+def test_pack_empty_and_single():
+    p = pack_graph(np.ones(1, np.float32), np.ones(1, np.float32),
+                   np.zeros(0, np.int32), np.zeros(0, np.int32))
+    assert p.n_levels == 1
+    assert p.offsets.tolist() == [0, 1]
+
+
+def test_plan_runs_fuses_small_waves():
+    # 5 small waves then one big one then 2 small
+    offsets = np.cumsum([0, 10, 20, 30, 40, 50, SMALL_WAVE * 3, 10, 10])
+    runs = _plan_runs(offsets.astype(np.int32))
+    assert runs[0] == (SMALL_WAVE, [0, 1, 2, 3, 4])
+    assert runs[1][1] == [5]
+    assert runs[1][0] > SMALL_WAVE
+    assert runs[2] == (SMALL_WAVE, [6, 7])
+
+
+# ------------------------------------------------------------- placement
+
+
+def test_chain_stays_local():
+    n = 50
+    durations = np.ones(n, np.float32)
+    out_bytes = np.full(n, 1e6, np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = src + 1
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    res = place_graph_leveled(packed, *workers(4))
+    validate_leveled(packed, res, src, dst, workers(4)[2])
+    assert res.n_waves == n
+    assert len(np.unique(res.assignment)) == 1  # heavy-dep following
+
+
+def test_mapreduce_spreads_roots_and_pins_reducers():
+    width, reducers = 64, 8
+    n = width + reducers + 1
+    durations = np.ones(n, np.float32)
+    out_bytes = np.full(n, 1e6, np.float32)
+    src, dst = [], []
+    per = width // reducers
+    for r in range(reducers):
+        for i in range(r * per, (r + 1) * per):
+            src.append(i)
+            dst.append(width + r)
+    for r in range(reducers):
+        src.append(width + r)
+        dst.append(width + reducers)
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    nthreads, occ, running = workers(8)
+    res = place_graph_leveled(packed, nthreads, occ, running)
+    validate_leveled(packed, res, src, dst, running)
+    assert res.n_waves == 3
+    a = res.assignment
+    counts = np.bincount(a[:width], minlength=8)
+    assert counts.max() <= 2 * counts.min() + 2, counts
+    # each reducer lands with one of its feeders (locality)
+    for r in range(reducers):
+        feeders = set(a[r * per:(r + 1) * per])
+        assert a[width + r] in feeders
+
+
+def test_stopped_workers_get_nothing():
+    rng = np.random.default_rng(3)
+    durations, out_bytes, src, dst = random_dag(rng, 800)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    nthreads, occ, running = workers(8, stopped=(2, 5))
+    res = place_graph_leveled(packed, nthreads, occ, running)
+    validate_leveled(packed, res, src, dst, running)
+    counts = np.bincount(res.assignment, minlength=8)
+    assert counts[2] == 0 and counts[5] == 0
+
+
+def test_random_dag_invariants_and_start_times():
+    rng = np.random.default_rng(4)
+    durations, out_bytes, src, dst = random_dag(rng, 5000)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    nthreads, occ, running = workers(16)
+    res = place_graph_leveled(packed, nthreads, occ, running)
+    validate_leveled(packed, res, src, dst, running)
+    # modeled start times respect dependency order
+    assert (res.start_time[dst] >= res.start_time[src]).all()
+    counts = np.bincount(res.assignment, minlength=16)
+    assert counts.max() / counts.mean() < 2.0
+
+
+def test_initial_occupancy_biases_spread():
+    # all workers idle except worker 0 which is very busy: the spread
+    # choice must put almost nothing new on worker 0
+    n = 1000
+    durations = np.ones(n, np.float32)
+    out_bytes = np.zeros(n, np.float32)
+    src = np.zeros(0, np.int32)
+    dst = np.zeros(0, np.int32)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    nthreads = np.full(4, 2, np.int32)
+    occ0 = np.asarray([1e6, 0, 0, 0], np.float32)
+    running = np.ones(4, bool)
+    res = place_graph_leveled(packed, nthreads, occ0, running)
+    counts = np.bincount(res.assignment, minlength=4)
+    assert counts[0] <= counts[1:].min()
+
+
+def test_wide_graph_exercises_fused_and_big_waves():
+    # two levels: one tiny (fused path), one far above SMALL_WAVE (big path)
+    n_roots = 4
+    n_leaves = SMALL_WAVE * 2 + 17
+    n = n_roots + n_leaves
+    durations = np.ones(n, np.float32)
+    out_bytes = np.full(n, 1e3, np.float32)
+    dst = np.arange(n_roots, n, dtype=np.int32)
+    src = (dst % n_roots).astype(np.int32)
+    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    nthreads, occ, running = workers(8)
+    res = place_graph_leveled(packed, nthreads, occ, running)
+    validate_leveled(packed, res, src, dst, running)
+    assert res.n_waves == 2
+    counts = np.bincount(res.assignment, minlength=8)
+    assert counts.max() / counts.mean() < 1.5
