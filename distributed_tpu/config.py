@@ -90,6 +90,12 @@ defaults: dict[str, Any] = {
             "monitor-interval": "100ms",
         },
     },
+    "shuffle": {                         # P2P shuffle engine storage layer
+        "disk": True,                    # spill received shards to disk
+        "memory-limit": "128MiB",        # backpressure threshold for buffered shards
+        "comm-message-bytes": "2MiB",    # outbound shard batch size per peer
+        "run-ttl": "300s",               # forget idle runs after this long
+    },
     "nanny": {
         "preload": [],
         "preload-argv": [],

@@ -37,10 +37,12 @@ def default_extensions() -> dict[str, Any]:
     from distributed_tpu.coordination.extensions import coordination_extensions
     from distributed_tpu.scheduler.amm import ActiveMemoryManagerExtension
     from distributed_tpu.scheduler.stealing import WorkStealing
+    from distributed_tpu.shuffle.scheduler_ext import ShuffleSchedulerExtension
 
     return {
         "stealing": WorkStealing,
         "amm": ActiveMemoryManagerExtension,
+        "shuffle": ShuffleSchedulerExtension,
         **coordination_extensions(),
     }
 

@@ -1,14 +1,27 @@
-from distributed_tpu.shuffle.api import p2p_rechunk, p2p_shuffle
+from distributed_tpu.shuffle.api import p2p_merge, p2p_rechunk, p2p_shuffle
+from distributed_tpu.shuffle.buffers import (
+    CommShardsBuffer,
+    DiskShardsBuffer,
+    MemoryShardsBuffer,
+    ResourceLimiter,
+)
 from distributed_tpu.shuffle.core import (
     ShuffleRun,
     ShuffleSpec,
     ShuffleWorkerExtension,
 )
+from distributed_tpu.shuffle.scheduler_ext import ShuffleSchedulerExtension
 
 __all__ = [
     "p2p_shuffle",
     "p2p_rechunk",
+    "p2p_merge",
     "ShuffleRun",
     "ShuffleSpec",
     "ShuffleWorkerExtension",
+    "ShuffleSchedulerExtension",
+    "ResourceLimiter",
+    "MemoryShardsBuffer",
+    "DiskShardsBuffer",
+    "CommShardsBuffer",
 ]

@@ -8,15 +8,24 @@ partitions, at O(N+M) scheduler tasks instead of O(N*M)
 Graph shape (built by ``distributed_tpu.shuffle.api``):
 
     transfer(i):  split input partition i by output -> push shards to the
-                  owner of each output partition (direct RPC)
+                  owner of each output partition (batched direct RPC via
+                  CommShardsBuffer)
     barrier:      after all transfers -> broadcast inputs_done to every
                   participant
     unpack(j):    restricted to worker_for[j] -> await inputs_done,
-                  assemble output partition j from received shards
+                  assemble output partition j from the spill store
 
-Runs are fenced by ``run_id`` epochs like the reference
-(shuffle/_worker_plugin.py:36): stale shards from a previous attempt of
-the same shuffle id are rejected, enabling restart after worker loss.
+Storage: received shards drain through a ``DiskShardsBuffer`` (spill
+files per output partition) or ``MemoryShardsBuffer``, both throttled by
+a ``ResourceLimiter`` — a shuffle can move far more data than fits in
+memory (reference shuffle/_disk.py, _limiter.py:89).
+
+Control plane: run specs are owned by the SCHEDULER extension
+(``shuffle.scheduler_ext``), which assigns output partitions to workers
+and bumps the ``run_id`` epoch on participating-worker loss or duplicate
+output fetches, releasing the shuffle's tasks for recomputation
+(reference shuffle/_scheduler_plugin.py:336-344).  Workers fence stale
+epochs by run_id (reference shuffle/_worker_plugin.py:36).
 """
 
 from __future__ import annotations
@@ -26,8 +35,15 @@ import logging
 from collections import defaultdict
 from typing import Any, Callable
 
+from distributed_tpu import config
 from distributed_tpu.exceptions import CommClosedError
 from distributed_tpu.protocol.serialize import Serialize, unwrap
+from distributed_tpu.shuffle.buffers import (
+    CommShardsBuffer,
+    DiskShardsBuffer,
+    MemoryShardsBuffer,
+    ResourceLimiter,
+)
 
 logger = logging.getLogger("distributed_tpu.shuffle")
 
@@ -37,7 +53,9 @@ class ShuffleClosedError(RuntimeError):
 
 
 class ShuffleSpec:
-    """Declarative description of one shuffle (reference shuffle/_core.py:421)."""
+    """Declarative description of one shuffle run (reference
+    shuffle/_core.py:421).  Created by the scheduler extension; run_id is
+    the fencing epoch."""
 
     __slots__ = ("id", "run_id", "npartitions_out", "worker_for")
 
@@ -71,12 +89,11 @@ class ShuffleSpec:
 class ShuffleRun:
     """Per-worker engine for one (id, run_id) (reference shuffle/_core.py:62)."""
 
-    def __init__(self, spec: ShuffleSpec, worker: Any):
+    def __init__(self, spec: ShuffleSpec, worker: Any, *,
+                 use_disk: bool | None = None,
+                 memory_limit: int | None = None):
         self.spec = spec
         self.worker = worker
-        # output partition -> {source tag: shard}; keyed by source so a
-        # recomputed transfer re-pushing its shards is idempotent
-        self.shards: defaultdict[int, dict[int, Any]] = defaultdict(dict)
         self.inputs_done = asyncio.Event()
         self.closed = False
         self.bytes_received = 0
@@ -84,6 +101,23 @@ class ShuffleRun:
         self.outputs_served: set[int] = set()
         self.local_outputs_left = sum(
             1 for addr in spec.worker_for.values() if addr == worker.address
+        )
+        if use_disk is None:
+            use_disk = bool(config.get("shuffle.disk"))
+        if memory_limit is None:
+            memory_limit = config.parse_bytes(config.get("shuffle.memory-limit"))
+        self.limiter = ResourceLimiter(memory_limit)
+        if use_disk:
+            import tempfile
+
+            directory = tempfile.mkdtemp(
+                prefix=f"dtpu-shuffle-{spec.id}-r{spec.run_id}-"
+            )
+            self.store: Any = DiskShardsBuffer(directory, limiter=self.limiter)
+        else:
+            self.store = MemoryShardsBuffer(limiter=self.limiter)
+        self.comms = CommShardsBuffer(
+            send=self._send_to_peer, limiter=ResourceLimiter(memory_limit)
         )
         from distributed_tpu.utils.misc import time as _now
 
@@ -104,6 +138,24 @@ class ShuffleRun:
 
     # ---------------------------------------------------------- data plane
 
+    async def _send_to_peer(self, addr: str, shards: list) -> None:
+        """CommShardsBuffer drain target: one batched push to one peer.
+        ``shards`` is a list of (output_partition, tag, shard)."""
+        by_output: defaultdict[int, list] = defaultdict(list)
+        for j, tag, shard in shards:
+            by_output[j].append((tag, shard))
+        resp = await self.worker.rpc(addr).shuffle_receive(
+            id=self.id, run_id=self.run_id,
+            spec=self.spec.to_msg(),
+            shards=Serialize(dict(by_output)),
+        )
+        if resp.get("status") == "stale":
+            raise ShuffleClosedError(
+                f"{self.id} run {self.run_id} superseded on {addr}"
+            )
+        if resp.get("status") != "OK":
+            raise RuntimeError(f"shuffle_receive failed on {addr}: {resp!r}")
+
     async def add_partition(self, data: Any, partition_id: int,
                             splitter: Callable) -> int:
         """Split one input partition and push shards to their owners
@@ -112,44 +164,39 @@ class ShuffleRun:
             raise ShuffleClosedError(self.id)
         self.touch()
         out_shards = splitter(data, self.spec.npartitions_out)
-        by_worker: defaultdict[str, dict[int, list]] = defaultdict(dict)
+        local: defaultdict[int, list] = defaultdict(list)
+        remote: defaultdict[str, list] = defaultdict(list)
         for j, shard in out_shards.items():
-            addr = self.spec.worker_for[j % self.spec.npartitions_out]
-            by_worker[addr].setdefault(j, []).append((partition_id, shard))
-
-        async def send(addr: str, shards: dict):
+            j = int(j) % self.spec.npartitions_out
+            addr = self.spec.worker_for[j]
             if addr == self.worker.address:
-                self.receive(shards)
-                return
-            # the spec rides along: the receiver may not have seen this
-            # shuffle yet (it owns outputs but runs no transfer tasks)
-            resp = await self.worker.rpc(addr).shuffle_receive(
-                id=self.id, run_id=self.run_id,
-                spec=self.spec.to_msg(),
-                shards=Serialize(shards),
-            )
-            if resp.get("status") != "OK":
-                raise RuntimeError(
-                    f"shuffle_receive failed on {addr}: {resp!r}"
-                )
-
-        await asyncio.gather(*(send(a, s) for a, s in by_worker.items()))
+                local[j].append((partition_id, shard))
+            else:
+                remote[addr].append((j, partition_id, shard))
+        if local:
+            await self.receive(dict(local))
+        if remote:
+            await self.comms.write(dict(remote))
         self.transfers_done.add(partition_id)
         return partition_id
 
-    def receive(self, shards: dict) -> None:
-        """Accept shards pushed by a peer (reference shuffle/_core.py:260)."""
+    async def receive(self, shards: dict) -> None:
+        """Accept shards pushed by a peer: drain into the spill store
+        (reference shuffle/_core.py:260)."""
         if self.closed:
             raise ShuffleClosedError(self.id)
         self.touch()
-        for j, tagged in shards.items():
-            bucket = self.shards[int(j)]
-            for tag, shard in tagged:
-                bucket[tag] = shard
+        data = {int(j): list(tagged) for j, tagged in shards.items()}
+        from distributed_tpu.utils.sizeof import sizeof
+
+        self.bytes_received += sizeof(data)
+        await self.store.write(data)
 
     async def barrier(self) -> None:
-        """All inputs transferred: notify every participant
-        (reference shuffle/_core.py:190)."""
+        """All inputs transferred: flush outbound shards, then notify
+        every participant (reference shuffle/_core.py:190)."""
+        await self.comms.flush()
+
         async def notify(addr: str):
             if addr == self.worker.address:
                 self.inputs_done.set()
@@ -159,51 +206,75 @@ class ShuffleRun:
                     id=self.id, run_id=self.run_id, spec=self.spec.to_msg()
                 )
             except (CommClosedError, OSError) as e:
-                raise RuntimeError(
-                    f"barrier could not reach {addr}"
-                ) from e
+                raise RuntimeError(f"barrier could not reach {addr}") from e
 
         await asyncio.gather(*(notify(a) for a in self.spec.participants))
 
-    async def get_output_partition(self, j: int, assembler: Callable,
-                                   timeout: float = 30.0) -> Any:
-        """Assemble output partition j (reference shuffle/_core.py:353)."""
+    async def collect_output(self, j: int, timeout: float = 30.0) -> list:
+        """The deduped, tag-ordered shard list for output partition j
+        (reference shuffle/_core.py:353).  Serves each partition exactly
+        once: a second request means a recomputed unpack would get an
+        empty partition, so the run fails for an epoch restart instead."""
         self.touch()
         await asyncio.wait_for(self.inputs_done.wait(), timeout)
         self.touch()
         if j in self.outputs_served:
-            # the bucket was consumed by a previous serve: a recomputed
-            # unpack must not silently get an empty partition — fail the
-            # run so the scheduler restarts it under a new run_id epoch
-            # (reference fails stale/duplicate fetches the same way)
             raise ShuffleClosedError(
                 f"{self.id}: output partition {j} already served; "
                 f"restart required"
             )
         self.outputs_served.add(j)
-        bucket = self.shards.pop(j, {})
+        tagged = await self.store.read(j)
+        # dedupe by source tag: a transfer that ran twice (worker retry)
+        # appended its shards twice; last write wins
+        bucket: dict[Any, Any] = {}
+        for tag, shard in tagged:
+            bucket[tag] = shard
         self.local_outputs_left -= 1
         if self.local_outputs_left <= 0:
-            # every local output served: schedule forgetting this run so
-            # long-lived workers don't accumulate one run per shuffle id
-            # (delayed: a rescheduled unpack may still re-request briefly)
             self.worker.shuffle.schedule_cleanup(self.id, self.run_id)
-        return assembler([bucket[tag] for tag in sorted(bucket)])
+        return [bucket[tag] for tag in sorted(bucket)]
+
+    async def get_output_partition(self, j: int, assembler: Callable,
+                                   timeout: float = 30.0) -> Any:
+        """Assemble output partition j, fetching from its owner when this
+        worker is not it (a recomputed unpack may have lost its worker
+        restriction — reference pins unpacks via _set_restriction,
+        _scheduler_plugin.py:281; the fetch fallback keeps mis-placed
+        recomputes correct instead of silently empty)."""
+        owner = self.spec.worker_for.get(int(j) % self.spec.npartitions_out)
+        if owner == self.worker.address or owner is None:
+            return assembler(await self.collect_output(j, timeout))
+        resp = await self.worker.rpc(owner).shuffle_fetch_output(
+            id=self.id, run_id=self.run_id, j=int(j)
+        )
+        if resp.get("status") != "OK":
+            raise ShuffleClosedError(
+                f"{self.id}: owner {owner} cannot serve partition {j}: "
+                f"{resp.get('status')}"
+            )
+        return assembler(unwrap(resp["shards"]))
 
     def close(self) -> None:
+        if self.closed:
+            return
         self.closed = True
-        self.shards.clear()
+        for buf in (self.store, self.comms):
+            self.worker._ongoing_background_tasks.call_soon(buf.close)
 
 
 class ShuffleWorkerExtension:
-    """Caches active runs by (id, run_id); fences stale epochs
+    """Caches active runs by (id, run_id); fences stale epochs; fetches
+    authoritative specs from the scheduler extension
     (reference shuffle/_worker_plugin.py:36)."""
 
     def __init__(self, worker: Any):
         self.worker = worker
         self.runs: dict[str, ShuffleRun] = {}  # id -> newest run
+        self.RUN_TTL = config.parse_timedelta(config.get("shuffle.run-ttl"))
         worker.handlers["shuffle_receive"] = self.shuffle_receive
         worker.handlers["shuffle_inputs_done"] = self.shuffle_inputs_done
+        worker.handlers["shuffle_fetch_output"] = self.shuffle_fetch_output
 
     def get_or_create(self, spec: ShuffleSpec) -> ShuffleRun:
         run = self.runs.get(spec.id)
@@ -221,6 +292,18 @@ class ShuffleWorkerExtension:
         # workers, cancelled shuffles) must not accumulate forever
         self.schedule_cleanup(spec.id, spec.run_id, delay=self.RUN_TTL)
         return run
+
+    async def get_or_create_remote(self, shuffle_id: str) -> ShuffleRun:
+        """Authoritative path for task bodies: ask the scheduler for the
+        CURRENT epoch's spec (a restarted shuffle has a bumped run_id)."""
+        resp = await self.worker.rpc(self.worker.scheduler_addr).shuffle_get_run(
+            id=shuffle_id
+        )
+        if resp.get("status") != "OK":
+            raise ShuffleClosedError(
+                f"scheduler does not know shuffle {shuffle_id}: {resp!r}"
+            )
+        return self.get_or_create(ShuffleSpec.from_msg(resp["spec"]))
 
     def _get_checked(self, id: str, run_id: int) -> ShuffleRun | None:
         run = self.runs.get(id)
@@ -242,8 +325,22 @@ class ShuffleWorkerExtension:
             if spec is None:
                 return {"status": "unknown-run", "id": id, "run_id": run_id}
             run = self.get_or_create(ShuffleSpec.from_msg(spec))
-        run.receive(unwrap(shards))
+        await run.receive(unwrap(shards))
         return {"status": "OK"}
+
+    async def shuffle_fetch_output(self, id: str = "", run_id: int = 0,
+                                   j: int = 0) -> dict:
+        """Serve an output partition's shards to a mis-placed unpack."""
+        run = self._get_checked(id, run_id)
+        if run is None:
+            return {"status": "stale", "id": id, "run_id": run_id}
+        try:
+            shards = await run.collect_output(j)
+        except ShuffleClosedError:
+            return {"status": "closed", "id": id, "run_id": run_id}
+        except asyncio.TimeoutError:
+            return {"status": "timeout", "id": id, "run_id": run_id}
+        return {"status": "OK", "shards": Serialize(shards)}
 
     async def shuffle_inputs_done(self, id: str = "", run_id: int = 0,
                                   spec: dict | None = None) -> dict:
@@ -254,8 +351,6 @@ class ShuffleWorkerExtension:
             run = self.get_or_create(ShuffleSpec.from_msg(spec))
         run.inputs_done.set()
         return {"status": "OK"}
-
-    RUN_TTL = 300.0  # forget idle runs after this long
 
     def schedule_cleanup(self, id: str, run_id: int, delay: float = 30.0) -> None:
         """Forget a run after a grace period; reschedules while active."""

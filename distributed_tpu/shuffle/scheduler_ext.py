@@ -1,0 +1,146 @@
+"""Scheduler-side shuffle control plane (reference
+shuffle/_scheduler_plugin.py).
+
+Owns the authoritative run spec per shuffle id:
+
+- assigns output partitions to workers round-robin over the running
+  workers (reference _calculate_worker_for, _scheduler_plugin.py:182);
+- hands the CURRENT epoch's spec to task bodies via the
+  ``shuffle_get_run`` RPC (workers never trust a spec baked into the
+  graph — it may predate a restart);
+- on participating-worker loss or a duplicate output fetch, bumps the
+  ``run_id`` epoch, reassigns output partitions over the surviving
+  workers, rewrites the unpack tasks' worker restrictions, and releases
+  the shuffle's transfer/barrier/unpack tasks so the whole run is
+  recomputed under the new epoch (reference remove_worker /
+  _restart_shuffle, _scheduler_plugin.py:336-344).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from distributed_tpu.utils.misc import seq_name
+
+logger = logging.getLogger("distributed_tpu.shuffle")
+
+
+class ShuffleState:
+    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for")
+
+    def __init__(self, id: str, run_id: int, npartitions_out: int,
+                 n_inputs: int, worker_for: dict[int, str]):
+        self.id = id
+        self.run_id = run_id
+        self.npartitions_out = npartitions_out
+        self.n_inputs = n_inputs
+        self.worker_for = worker_for
+
+    def to_msg(self) -> dict:
+        return {
+            "id": self.id,
+            "run_id": self.run_id,
+            "npartitions_out": self.npartitions_out,
+            "worker_for": {str(k): v for k, v in self.worker_for.items()},
+        }
+
+
+class ShuffleSchedulerExtension:
+    """Registered as ``extensions['shuffle']`` (reference
+    DEFAULT_EXTENSIONS, scheduler.py:178-193)."""
+
+    def __init__(self, scheduler: Any):
+        self.scheduler = scheduler
+        self.active: dict[str, ShuffleState] = {}
+        scheduler.handlers.update(
+            {
+                "shuffle_get_or_create": self.handle_get_or_create,
+                "shuffle_get_run": self.handle_get_run,
+                "shuffle_restart": self.handle_restart,
+            }
+        )
+
+    # ------------------------------------------------------------ helpers
+
+    def _calculate_worker_for(self, npartitions_out: int) -> dict[int, str]:
+        """Round-robin output partitions over sorted running workers
+        (reference _scheduler_plugin.py:182)."""
+        addrs = sorted(ws.address for ws in self.scheduler.state.running)
+        if not addrs:
+            addrs = sorted(self.scheduler.state.workers)
+        if not addrs:
+            raise RuntimeError("no workers available for shuffle")
+        return {j: addrs[j % len(addrs)] for j in range(npartitions_out)}
+
+    def _task_keys(self, st: ShuffleState) -> list[str]:
+        keys = [f"{st.id}-transfer-{i}" for i in range(st.n_inputs)]
+        keys.append(f"{st.id}-barrier")
+        keys.extend(f"{st.id}-unpack-{j}" for j in range(st.npartitions_out))
+        return keys
+
+    def _restart(self, st: ShuffleState, reason: str) -> None:
+        st.run_id += 1
+        st.worker_for = self._calculate_worker_for(st.npartitions_out)
+        logger.warning(
+            "shuffle %s restarting as run %d (%s)", st.id, st.run_id, reason
+        )
+        state = self.scheduler.state
+        # retarget unpack restrictions at the new owners
+        for j, addr in st.worker_for.items():
+            ts = state.tasks.get(f"{st.id}-unpack-{j}")
+            if ts is not None:
+                ts.worker_restrictions = {addr}
+        # release the whole pipeline for recomputation under the new epoch
+        recs = {
+            k: "released"
+            for k in self._task_keys(st)
+            if k in state.tasks and state.tasks[k].state != "released"
+        }
+        if recs:
+            stimulus_id = seq_name("shuffle-restart")
+            client_msgs, worker_msgs = state.transitions(recs, stimulus_id)
+            self.scheduler.send_all(client_msgs, worker_msgs)
+
+    # ----------------------------------------------------------- handlers
+
+    async def handle_get_or_create(
+        self, id: str = "", npartitions_out: int = 0, n_inputs: int = 0,
+        **kwargs: Any,
+    ) -> dict:
+        st = self.active.get(id)
+        if st is None:
+            st = self.active[id] = ShuffleState(
+                id, 1, npartitions_out, n_inputs,
+                self._calculate_worker_for(npartitions_out),
+            )
+        return {"status": "OK", "spec": st.to_msg()}
+
+    async def handle_get_run(self, id: str = "", **kwargs: Any) -> dict:
+        st = self.active.get(id)
+        if st is None:
+            return {"status": "unknown-shuffle", "id": id}
+        return {"status": "OK", "spec": st.to_msg()}
+
+    async def handle_restart(self, id: str = "", run_id: int = 0,
+                             **kwargs: Any) -> dict:
+        """A worker hit a fatal run condition (e.g. duplicate output
+        fetch): restart iff the reported epoch is still current."""
+        st = self.active.get(id)
+        if st is None:
+            return {"status": "unknown-shuffle", "id": id}
+        if run_id == st.run_id:
+            self._restart(st, f"worker-requested (run {run_id})")
+        return {"status": "OK", "run_id": st.run_id}
+
+    # ------------------------------------------------- scheduler callbacks
+
+    def remove_worker(self, scheduler: Any, address: str) -> None:
+        """Participating worker died: every shuffle it owned outputs for
+        (or might hold transfer state for) restarts under a new epoch."""
+        for st in list(self.active.values()):
+            if address in set(st.worker_for.values()):
+                self._restart(st, f"lost worker {address}")
+
+    def forget(self, id: str) -> None:
+        self.active.pop(id, None)

@@ -492,6 +492,10 @@ class WorkerState:
             ("cancelled", "memory"): self._transition_cancelled_memory,
             ("cancelled", "error"): self._transition_cancelled_error,
             ("cancelled", "rescheduled"): self._transition_cancelled_released,
+            # resumed (cancelled then wanted again) execute ending in
+            # Reschedule: nothing was produced — tell the scheduler to
+            # re-place it, exactly like an executing task would
+            ("resumed", "rescheduled"): self._transition_executing_rescheduled,
             ("resumed", "memory"): self._transition_executing_memory,
             ("resumed", "released"): self._transition_generic_released,
             ("resumed", "error"): self._transition_executing_error,
@@ -1240,7 +1244,9 @@ class WorkerState:
                     assert ts in dts.waiters, (ts, dts)
                     assert dts.state != "memory", (ts, dts)
             for ts in self.executing:
-                assert ts.state in ("executing", "cancelled"), ts
+                # resumed: cancelled mid-execute, then wanted again — the
+                # in-flight execute keeps running and its result is reused
+                assert ts.state in ("executing", "cancelled", "resumed"), ts
             for worker, keys in self.in_flight_workers.items():
                 for key in keys:
                     ts = self.tasks.get(key)

@@ -135,4 +135,4 @@ async def test_shuffle_run_id_fencing():
             shards={0: [(0, [3])]},
         )
         assert resp["status"] == "OK"
-        assert dict(run2.shards[0]) == {0: [3]}
+        assert await run2.store.read(0) == [(0, [3])]
