@@ -93,6 +93,16 @@ def _pack_numpy(durations, out_bytes, src, dst):
         first[1:] = dsorted[1:] != dsorted[:-1]
         heavy[dsorted[first]] = src[order][first]
 
+    # CSR adjacency grouped by src so each level touches only the
+    # frontier's own out-edges (O(T+E) overall like graphpack.cpp, not
+    # O(E*L) as the old np.isin-per-level scan was on deep graphs)
+    if E:
+        eorder = np.argsort(src, kind="stable")
+        dst_csr = dst[eorder]
+        out_off = np.zeros(T + 1, np.int64)
+        np.add.at(out_off, src + 1, 1)
+        np.cumsum(out_off, out=out_off)
+
     level = np.full(T, -1, np.int32)
     placed = 0
     lvl = 0
@@ -105,10 +115,19 @@ def _pack_numpy(durations, out_bytes, src, dst):
         placed += len(frontier)
         offsets.append(placed)
         if E:
-            fired = np.isin(src, frontier)
-            np.add.at(indeg, dst[fired], -1)
-            indeg[frontier] = INT32_MAX  # never ready again
-            frontier = np.nonzero(indeg == 0)[0]
+            starts = out_off[frontier]
+            counts = out_off[frontier + 1] - starts
+            total = int(counts.sum())
+            if total:
+                cum = np.cumsum(counts)
+                idx = np.arange(total, dtype=np.int64) + np.repeat(
+                    starts - (cum - counts), counts
+                )
+                targets = dst_csr[idx]
+                np.add.at(indeg, targets, -1)
+                frontier = np.unique(targets[indeg[targets] == 0])
+            else:
+                frontier = np.zeros(0, np.int64)
         else:
             frontier = np.zeros(0, np.int64)
         lvl += 1

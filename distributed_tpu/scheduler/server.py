@@ -411,8 +411,14 @@ class Scheduler(Server):
         s = self.state
         # task activity only — a connected-but-inactive client must not
         # keep an idle cluster alive forever (reference idle-timeout
-        # semantics, scheduler.py:8326)
+        # semantics, scheduler.py:8326).  Also reset whenever the
+        # transition counter advanced since the last check: bursts of
+        # short tasks that start AND finish between two checks are
+        # activity, not idleness (reference scheduler.py:8330).
         busy = any(ws.processing for ws in s.workers.values()) or s.queued or s.unrunnable
+        if s.transition_counter != getattr(self, "_idle_transition_counter", -1):
+            self._idle_transition_counter = s.transition_counter
+            busy = True
         if busy:
             self.idle_since = None
             return

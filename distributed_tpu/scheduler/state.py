@@ -656,6 +656,21 @@ class SchedulerState:
                     return {ts.key: "no-worker"}, {}, {}
         else:
             if not (ws := self.decide_worker_non_rootish(ts)):
+                if ts.waiting_on:
+                    # A dependency's last replica vanished between the
+                    # transition that recommended us and placement (worker
+                    # death race); _decide_worker_locality parked us back in
+                    # waiting.  Kick recompute of the bare deps instead of
+                    # crashing (reference scheduler.py:2247-2250 guards the
+                    # equivalent invariant behind validate).
+                    return (
+                        {
+                            dts.key: "waiting" if dts.state == "released" else "released"
+                            for dts in ts.waiting_on
+                        },
+                        {},
+                        {},
+                    )
                 return {ts.key: "no-worker"}, {}, {}
         worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
         self._count_transition(ts, "waiting", "processing")
@@ -973,6 +988,20 @@ class SchedulerState:
             worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
             self._count_transition(ts, "no-worker", "processing")
             return {}, {}, worker_msgs
+        if ts.waiting_on:
+            # bare-dep reroute (see _transition_waiting_processing): move back
+            # to waiting and recompute the deps whose replicas vanished
+            del self.unrunnable[ts]
+            ts.state = "waiting"
+            self._count_transition(ts, "no-worker", "waiting")
+            return (
+                {
+                    dts.key: "waiting" if dts.state == "released" else "released"
+                    for dts in ts.waiting_on
+                },
+                {},
+                {},
+            )
         return {}, {}, {}
 
     def _transition_memory_released(
@@ -1324,11 +1353,27 @@ class SchedulerState:
     def _decide_worker_locality(
         self, ts: TaskState, valid_workers: set[WorkerState] | None
     ) -> WorkerState | None:
-        """The python oracle for decide_worker (reference scheduler.py:8550)."""
-        assert all(dts.who_has for dts in ts.dependencies), (
-            ts,
-            [d for d in ts.dependencies if not d.who_has],
-        )
+        """The python oracle for decide_worker (reference scheduler.py:8550).
+
+        A dependency may lose its last replica between the transition that
+        recommended this placement and the placement itself (worker death
+        races).  The reference guards the invariant check behind ``validate``
+        (reference scheduler.py:2247-2250); in production we reroute the
+        bare dependency through ``released`` instead of crashing.
+        """
+        if self.validate:
+            assert all(dts.who_has for dts in ts.dependencies), (
+                ts,
+                [d for d in ts.dependencies if not d.who_has],
+            )
+        bare = [dts for dts in ts.dependencies if not dts.who_has]
+        if bare:
+            # Replica vanished in a race: park this task back in waiting on
+            # the bare deps; _transition_waiting_processing kicks recompute.
+            for dts in bare:
+                ts.waiting_on.add(dts)
+                dts.waiters.add(ts)
+            return None
         if ts.actor:
             candidates = set(self.running)
         else:

@@ -268,16 +268,38 @@ class DiskShardsBuffer(ShardsBuffer):
 
 class CommShardsBuffer(ShardsBuffer):
     """Batches outbound shards per destination worker and pushes them
-    with a caller-provided async send (reference shuffle/_comms.py)."""
+    with a caller-provided async send (reference shuffle/_comms.py).
+
+    ``message_bytes_limit`` (config ``shuffle.comm-message-bytes``) caps a
+    single RPC message: a backed-up bucket is split into several sends
+    rather than serialized as one giant message (reference _comms.py
+    message-bytes-limit semantics)."""
 
     def __init__(
         self,
         send: Callable[[str, list], Awaitable[None]],
         limiter: ResourceLimiter | None = None,
         concurrency: int = 4,
+        message_bytes_limit: int | None = None,
     ):
         super().__init__(limiter=limiter, concurrency=concurrency)
         self._send = send
+        self.message_bytes_limit = message_bytes_limit
 
     async def _process(self, id: Any, shards: list) -> None:
-        await self._send(id, shards)
+        limit = self.message_bytes_limit
+        if not limit or len(shards) <= 1:
+            await self._send(id, shards)
+            return
+        batch: list = []
+        batch_bytes = 0
+        for shard in shards:
+            n = _nbytes(shard)
+            if batch and batch_bytes + n > limit:
+                await self._send(id, batch)
+                batch = []
+                batch_bytes = 0
+            batch.append(shard)
+            batch_bytes += n
+        if batch:
+            await self._send(id, batch)

@@ -117,7 +117,11 @@ class ShuffleRun:
         else:
             self.store = MemoryShardsBuffer(limiter=self.limiter)
         self.comms = CommShardsBuffer(
-            send=self._send_to_peer, limiter=ResourceLimiter(memory_limit)
+            send=self._send_to_peer,
+            limiter=ResourceLimiter(memory_limit),
+            message_bytes_limit=config.parse_bytes(
+                config.get("shuffle.comm-message-bytes")
+            ),
         )
         from distributed_tpu.utils.misc import time as _now
 
@@ -193,22 +197,29 @@ class ShuffleRun:
         await self.store.write(data)
 
     async def barrier(self) -> None:
-        """All inputs transferred: flush outbound shards, then notify
-        every participant (reference shuffle/_core.py:190)."""
-        await self.comms.flush()
-
-        async def notify(addr: str):
-            if addr == self.worker.address:
-                self.inputs_done.set()
-                return
-            try:
-                await self.worker.rpc(addr).shuffle_inputs_done(
-                    id=self.id, run_id=self.run_id, spec=self.spec.to_msg()
-                )
-            except (CommClosedError, OSError) as e:
-                raise RuntimeError(f"barrier could not reach {addr}") from e
-
-        await asyncio.gather(*(notify(a) for a in self.spec.participants))
+        """All inputs transferred: route the barrier through the scheduler
+        extension, which broadcasts inputs_done to EVERY participating
+        worker (transfer-only ones included) and waits for each to flush
+        its outbound shards before acknowledging (reference
+        shuffle/_core.py:190, _scheduler_plugin.py:95).  Flushing only our
+        own comms here would race unpack against other workers' in-flight
+        shards."""
+        await self.comms.flush()  # local head start; scheduler re-flushes
+        try:
+            resp = await self.worker.rpc(
+                self.worker.scheduler_addr
+            ).shuffle_barrier(id=self.id, run_id=self.run_id)
+        except (CommClosedError, OSError) as e:
+            raise RuntimeError("barrier could not reach scheduler") from e
+        status = resp.get("status")
+        if status == "stale":
+            raise ShuffleClosedError(
+                f"{self.id} run {self.run_id} superseded by {resp.get('run_id')}"
+            )
+        if status != "OK":
+            raise ShuffleClosedError(
+                f"{self.id} barrier failed: {resp.get('error', status)}"
+            )
 
     async def collect_output(self, j: int, timeout: float = 30.0) -> list:
         """The deduped, tag-ordered shard list for output partition j
@@ -297,7 +308,7 @@ class ShuffleWorkerExtension:
         """Authoritative path for task bodies: ask the scheduler for the
         CURRENT epoch's spec (a restarted shuffle has a bumped run_id)."""
         resp = await self.worker.rpc(self.worker.scheduler_addr).shuffle_get_run(
-            id=shuffle_id
+            id=shuffle_id, worker=self.worker.address
         )
         if resp.get("status") != "OK":
             raise ShuffleClosedError(
@@ -348,7 +359,15 @@ class ShuffleWorkerExtension:
         if run is None:
             if spec is None:
                 return {"status": "stale"}
-            run = self.get_or_create(ShuffleSpec.from_msg(spec))
+            try:
+                run = self.get_or_create(ShuffleSpec.from_msg(spec))
+            except ShuffleClosedError:
+                return {"status": "stale"}
+        # flush OUR outbound shards before acknowledging: the barrier task
+        # completes only once every participant has drained onto the wire,
+        # so no unpack can read ahead of an in-flight shard (reference
+        # _core.py:272 _flush_comm-inside-inputs_done)
+        await run.comms.flush()
         run.inputs_done.set()
         return {"status": "OK"}
 

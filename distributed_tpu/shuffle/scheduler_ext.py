@@ -27,7 +27,8 @@ logger = logging.getLogger("distributed_tpu.shuffle")
 
 
 class ShuffleState:
-    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for")
+    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for",
+                 "participants")
 
     def __init__(self, id: str, run_id: int, npartitions_out: int,
                  n_inputs: int, worker_for: dict[int, str]):
@@ -36,6 +37,14 @@ class ShuffleState:
         self.npartitions_out = npartitions_out
         self.n_inputs = n_inputs
         self.worker_for = worker_for
+        # every worker that touched this epoch (transfer-only workers
+        # included) — the barrier must flush ALL of them, not just output
+        # owners (reference _scheduler_plugin.py:95)
+        self.participants: set[str] = set()
+
+    @property
+    def all_workers(self) -> set[str]:
+        return self.participants | set(self.worker_for.values())
 
     def to_msg(self) -> dict:
         return {
@@ -58,6 +67,7 @@ class ShuffleSchedulerExtension:
                 "shuffle_get_or_create": self.handle_get_or_create,
                 "shuffle_get_run": self.handle_get_run,
                 "shuffle_restart": self.handle_restart,
+                "shuffle_barrier": self.handle_barrier,
             }
         )
 
@@ -81,7 +91,16 @@ class ShuffleSchedulerExtension:
 
     def _restart(self, st: ShuffleState, reason: str) -> None:
         st.run_id += 1
-        st.worker_for = self._calculate_worker_for(st.npartitions_out)
+        try:
+            st.worker_for = self._calculate_worker_for(st.npartitions_out)
+        except RuntimeError:
+            # no workers left (cluster draining): the shuffle cannot be
+            # recomputed now; drop it so task bodies get unknown-shuffle
+            # and reschedule when workers return
+            logger.warning("shuffle %s unrecoverable (%s): no workers", st.id, reason)
+            self.active.pop(st.id, None)
+            return
+        st.participants = set()  # re-registered as the new epoch's tasks run
         logger.warning(
             "shuffle %s restarting as run %d (%s)", st.id, st.run_id, reason
         )
@@ -106,7 +125,7 @@ class ShuffleSchedulerExtension:
 
     async def handle_get_or_create(
         self, id: str = "", npartitions_out: int = 0, n_inputs: int = 0,
-        **kwargs: Any,
+        worker: str = "", **kwargs: Any,
     ) -> dict:
         st = self.active.get(id)
         if st is None:
@@ -114,13 +133,55 @@ class ShuffleSchedulerExtension:
                 id, 1, npartitions_out, n_inputs,
                 self._calculate_worker_for(npartitions_out),
             )
+        if worker:
+            st.participants.add(worker)
         return {"status": "OK", "spec": st.to_msg()}
 
-    async def handle_get_run(self, id: str = "", **kwargs: Any) -> dict:
+    async def handle_get_run(self, id: str = "", worker: str = "",
+                             **kwargs: Any) -> dict:
         st = self.active.get(id)
         if st is None:
             return {"status": "unknown-shuffle", "id": id}
+        if worker:
+            st.participants.add(worker)
         return {"status": "OK", "spec": st.to_msg()}
+
+    async def handle_barrier(self, id: str = "", run_id: int = 0,
+                             **kwargs: Any) -> dict:
+        """Broadcast inputs_done to EVERY participating worker (transfer
+        and unpack) and wait for each to flush its outbound shard buffer
+        before acknowledging — only then may the barrier task complete and
+        unpacks start reading (reference _scheduler_plugin.py:95,
+        _core.py:272)."""
+        import asyncio
+
+        st = self.active.get(id)
+        if st is None:
+            return {"status": "unknown-shuffle", "id": id}
+        if run_id != st.run_id:
+            return {"status": "stale", "id": id, "run_id": st.run_id}
+        spec = st.to_msg()
+
+        async def one(addr: str):
+            resp = await self.scheduler.rpc(addr).shuffle_inputs_done(
+                id=id, run_id=run_id, spec=spec
+            )
+            if resp.get("status") != "OK":
+                raise RuntimeError(
+                    f"inputs_done rejected by {addr}: {resp!r}"
+                )
+
+        results = await asyncio.gather(
+            *(one(a) for a in sorted(st.all_workers)), return_exceptions=True
+        )
+        failures = [r for r in results if isinstance(r, BaseException)]
+        if failures:
+            # a participant died or went stale mid-barrier: restart the
+            # epoch rather than serve partial outputs
+            if run_id == st.run_id:
+                self._restart(st, f"barrier failed: {failures[0]!r}")
+            return {"status": "error", "error": repr(failures[0])}
+        return {"status": "OK", "run_id": run_id}
 
     async def handle_restart(self, id: str = "", run_id: int = 0,
                              **kwargs: Any) -> dict:
@@ -137,9 +198,10 @@ class ShuffleSchedulerExtension:
 
     def remove_worker(self, scheduler: Any, address: str) -> None:
         """Participating worker died: every shuffle it owned outputs for
-        (or might hold transfer state for) restarts under a new epoch."""
+        or held transfer state for restarts under a new epoch
+        (reference _scheduler_plugin.py:344)."""
         for st in list(self.active.values()):
-            if address in set(st.worker_for.values()):
+            if address in st.all_workers:
                 self._restart(st, f"lost worker {address}")
 
     def forget(self, id: str) -> None:

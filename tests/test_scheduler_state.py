@@ -387,3 +387,41 @@ def test_occupancy_tracking():
     assert sim.state.total_occupancy > 0
     sim.run_to_completion()
     assert sim.state.total_occupancy == pytest.approx(0.0, abs=1e-9)
+
+
+def test_placement_reroutes_on_vanished_replica():
+    """A dependency's last replica vanishes between the transition that
+    recommended a task to processing and the placement itself (worker death
+    race).  Production mode must reroute the dep through released→recompute
+    instead of crashing (reference scheduler.py:2247-2250 guards the invariant
+    behind validate)."""
+    sim = Sim(nworkers=2, validate=False)
+    g = Graph()
+    g["a"] = TaskSpec(lambda: 1)
+    g["b"] = TaskSpec(lambda x: x + 1, (TaskRef("a"),))
+    sim.submit_graph(g, ["b"])
+    st = sim.state
+    addr_a = st.tasks["a"].processing_on.address
+    sim.finish(addr_a, "a")
+    ta, tb = st.tasks["a"], st.tasks["b"]
+    assert ta.state == "memory" and tb.state == "processing"
+
+    # reproduce the race: a's replicas vanish while its state is still memory
+    for ws in list(ta.who_has):
+        st.remove_replica(ta, ws)
+    assert not ta.who_has and ta.state == "memory"
+
+    # winding b back through released triggers waiting -> processing placement
+    # against the inconsistent state; must not raise
+    cmsgs, wmsgs = st.transitions({"b": "released"}, "test-race")
+    sim._route(cmsgs, wmsgs)
+
+    # b parked in waiting on a; a recommended for recompute
+    assert tb.state == "waiting"
+    assert ta in tb.waiting_on
+    assert ta.state == "processing"
+
+    # the recompute converges and b completes
+    sim.run_to_completion()
+    assert tb.state == "memory"
+    assert "key-in-memory" in [m["op"] for m in sim.client_reports()]

@@ -136,3 +136,43 @@ async def test_shuffle_run_id_fencing():
         )
         assert resp["status"] == "OK"
         assert await run2.store.read(0) == [(0, [3])]
+
+
+@gen_test(timeout=120)
+async def test_transfer_only_worker_shards_flushed_before_unpack():
+    """ADVICE r2 (high): a worker that runs transfers but owns no output
+    partitions has its outbound shard buffer still draining when the
+    barrier fires.  The barrier must broadcast inputs_done to ALL
+    participants (not just output owners) and each must flush its comms
+    before acknowledging — otherwise unpack silently drops rows
+    (reference _core.py:272, _scheduler_plugin.py:95)."""
+    from distributed_tpu.shuffle.core import ShuffleRun
+
+    orig_send = ShuffleRun._send_to_peer
+
+    async def slow_send(self, addr, shards):
+        await asyncio.sleep(0.3)  # keep shards in flight past the barrier
+        await orig_send(self, addr, shards)
+
+    ShuffleRun._send_to_peer = slow_send
+    try:
+        async with await new_cluster(n_workers=3) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                addrs = sorted(cluster.scheduler.state.workers)
+                transfer_only = addrs[2]  # 2 outputs -> owners = addrs[:2]
+                inputs = [
+                    c.submit(make_partition, i, key=f"tfo-{i}",
+                             workers=[transfer_only])
+                    for i in range(4)
+                ]
+                await c.gather(inputs)
+                outs = await p2p_shuffle(c, inputs, npartitions_out=2)
+                results = await asyncio.wait_for(c.gather(outs), 60)
+                ext = cluster.scheduler.extensions["shuffle"]
+                st = next(iter(ext.active.values()))
+                assert transfer_only in st.participants
+                all_in = sorted(x for i in range(4) for x in make_partition(i))
+                all_out = sorted(x for part in results for x in part)
+                assert all_out == all_in
+    finally:
+        ShuffleRun._send_to_peer = orig_send
