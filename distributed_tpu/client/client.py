@@ -459,6 +459,7 @@ class Client:
         pure: bool = True,
         priority: int = 0,
         workers: list[str] | str | None = None,
+        allow_other_workers: bool = False,
         resources: dict | None = None,
         retries: int | None = None,
         **kwargs: Any,
@@ -480,7 +481,8 @@ class Client:
         futs = self._graph_to_futures(
             {k: v for k, v in tasks.items()},
             [k for k in dict.fromkeys(keys)],
-            priority=priority, workers=workers, resources=resources,
+            priority=priority, workers=workers,
+            allow_other_workers=allow_other_workers, resources=resources,
             retries=retries,
         )
         return [futs.get(k) or Future(k, self) for k in keys]

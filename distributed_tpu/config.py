@@ -46,7 +46,12 @@ defaults: dict[str, Any] = {
             "enabled": True,            # use device kernels when available
             "platform": "auto",         # auto | tpu | cpu
             "batch-size": 2048,         # stimulus batch per device step
-            "min-batch": 32,            # below this, pure-python path is faster
+            "min-batch": 512,           # below this, pure-python path is faster
+            "min-workers": 32,          # below this, the O(deps) python
+                                        # oracle wins: whole-graph plans
+                                        # diverge from stealing/queuing
+                                        # dynamics faster than they pay off
+            "sync-plan": False,         # plan on-loop (deterministic tests)
             "capacity-doubling": True,  # grow SoA arrays by 2x
             "parity-check": False,      # run python oracle in lockstep (tests)
         },

@@ -8,12 +8,64 @@ Prometheus collectors and ``/info`` routes.
 from __future__ import annotations
 
 import logging
+import threading
 from collections import deque
 from typing import Any
 
 from distributed_tpu.utils.misc import time
 
 logger = logging.getLogger("distributed_tpu.system_monitor")
+
+# process-global psutil sample cache: /proc reads (disk_io_counters in
+# particular) cost milliseconds, and an in-process LocalCluster runs one
+# monitor per worker — without sharing, N monitors make N identical
+# psutil sweeps per interval.  Rates (bps) are computed HERE from the
+# cache's own sample-to-sample dt: monitors polling faster than the TTL
+# would otherwise see zero deltas then stale deltas over their private
+# dt (0, 0, 3x oscillation).
+_HOST_TTL = 1.0
+_host_lock = threading.Lock()
+_host_cache: dict[str, Any] = {"t": -1e9}
+
+
+def _sample_host() -> dict[str, Any]:
+    now = time()
+    with _host_lock:
+        if now - _host_cache["t"] > _HOST_TTL:
+            net = SystemMonitor._net_counters()
+            disk = SystemMonitor._disk_counters()
+            prev_t = _host_cache["t"]
+            dt = now - prev_t
+            if "net" in _host_cache and dt < 10 * _HOST_TTL:
+                pnet, pdisk = _host_cache["net"], _host_cache["disk"]
+                _host_cache["net_read_bps"] = (net[0] - pnet[0]) / dt
+                _host_cache["net_write_bps"] = (net[1] - pnet[1]) / dt
+                _host_cache["disk_read_bps"] = (disk[0] - pdisk[0]) / dt
+                _host_cache["disk_write_bps"] = (disk[1] - pdisk[1]) / dt
+            else:
+                _host_cache["net_read_bps"] = _host_cache["net_write_bps"] = 0.0
+                _host_cache["disk_read_bps"] = _host_cache["disk_write_bps"] = 0.0
+            _host_cache["net"] = net
+            _host_cache["disk"] = disk
+            _host_cache["cpu"], _host_cache["mem"] = _proc_stats()
+            _host_cache["t"] = now
+        return dict(_host_cache)
+
+
+_proc = None
+
+
+def _proc_stats() -> tuple[float, int]:
+    global _proc
+    try:
+        if _proc is None:
+            import psutil
+
+            _proc = psutil.Process()
+            _proc.cpu_percent()  # prime the interval sampler
+        return _proc.cpu_percent(), _proc.memory_info().rss
+    except Exception:
+        return 0.0, 0
 
 
 class SystemMonitor:
@@ -29,16 +81,7 @@ class SystemMonitor:
             "host_disk_io.write_bps": deque(maxlen=maxlen),
         }
         self.count = 0
-        self._last_time = time()
-        self._last_net = self._net_counters()
-        self._last_disk = self._disk_counters()
-        try:
-            import psutil
-
-            self._proc = psutil.Process()
-            self._proc.cpu_percent()  # prime the interval sampler
-        except Exception:
-            self._proc = None
+        _sample_host()  # prime the shared cache
 
     @staticmethod
     def _net_counters():
@@ -65,28 +108,16 @@ class SystemMonitor:
     def update(self) -> dict[str, Any]:
         """Take one sample; returns it (reference system_monitor.py:141)."""
         now = time()
-        dt = max(now - self._last_time, 1e-6)
-        self._last_time = now
-        cpu = mem = 0.0
-        if self._proc is not None:
-            try:
-                cpu = self._proc.cpu_percent()
-                mem = self._proc.memory_info().rss
-            except Exception:
-                pass
-        net = self._net_counters()
-        disk = self._disk_counters()
+        host = _sample_host()
         sample = {
             "time": now,
-            "cpu": cpu,
-            "memory": mem,
-            "host_net_io.read_bps": (net[0] - self._last_net[0]) / dt,
-            "host_net_io.write_bps": (net[1] - self._last_net[1]) / dt,
-            "host_disk_io.read_bps": (disk[0] - self._last_disk[0]) / dt,
-            "host_disk_io.write_bps": (disk[1] - self._last_disk[1]) / dt,
+            "cpu": host["cpu"],
+            "memory": host["mem"],
+            "host_net_io.read_bps": host["net_read_bps"],
+            "host_net_io.write_bps": host["net_write_bps"],
+            "host_disk_io.read_bps": host["disk_read_bps"],
+            "host_disk_io.write_bps": host["disk_write_bps"],
         }
-        self._last_net = net
-        self._last_disk = disk
         for k, v in sample.items():
             self.quantities[k].append(v)
         self.count += 1

@@ -122,7 +122,7 @@ def load() -> ctypes.CDLL | None:
             _f32p, _f32p, _i32p, _i32p,
             ctypes.c_double,
             _i32p, _i32p, _i32p,
-            _f32p, _i32p, _f32p, _f32p,
+            _f32p, _i32p, _i32p, _f32p, _f32p, _f32p,
         ]
         _lib = lib
         return _lib

@@ -26,26 +26,32 @@ extern "C" {
 //   perm[i]      original index of the i-th task in (level, index) order
 //   heavy[t]     dependency of t with the largest out_bytes (-1 if none;
 //                ties broken toward the lowest source index)
+//   heavy2[t]    second-largest dependency by out_bytes (-1 if <2 deps);
+//                the placement kernel weighs BOTH producers' workers as
+//                locality candidates (join-shaped tasks — tensordot,
+//                merges — have two comparable inputs)
 //   dep_total[t] sum of out_bytes over t's dependencies
 //   offsets[l]   start of level l in perm; offsets[n_levels] == T
 int64_t graphpack(
     int64_t T, int64_t E,
     const float* out_bytes,
     const int32_t* src, const int32_t* dst,
-    int32_t* level, int32_t* perm, int32_t* heavy, float* dep_total,
-    int32_t* offsets)
+    int32_t* level, int32_t* perm, int32_t* heavy, int32_t* heavy2,
+    float* dep_total, int32_t* offsets)
 {
     if (T <= 0) return 0;
 
     std::vector<int32_t> indeg(T, 0);
     std::vector<float> heavy_bytes(T, -1.0f);
+    std::vector<float> heavy2_bytes(T, -1.0f);
     for (int64_t t = 0; t < T; ++t) {
         heavy[t] = -1;
+        heavy2[t] = -1;
         dep_total[t] = 0.0f;
         level[t] = -1;
     }
 
-    // one edge pass: indegree, heavy dep, dep byte totals
+    // one edge pass: indegree, top-2 heavy deps, dep byte totals
     for (int64_t e = 0; e < E; ++e) {
         int32_t s = src[e], d = dst[e];
         if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
@@ -53,8 +59,14 @@ int64_t graphpack(
         float b = out_bytes[s];
         dep_total[d] += b;
         if (b > heavy_bytes[d] || (b == heavy_bytes[d] && s < heavy[d])) {
+            heavy2_bytes[d] = heavy_bytes[d];
+            heavy2[d] = heavy[d];
             heavy_bytes[d] = b;
             heavy[d] = s;
+        } else if (b > heavy2_bytes[d]
+                   || (b == heavy2_bytes[d] && s < heavy2[d])) {
+            heavy2_bytes[d] = b;
+            heavy2[d] = s;
         }
     }
 
@@ -114,10 +126,12 @@ int64_t graphpack(
 // Full pack: graphpack plus the level-sorted, remapped per-task arrays
 // the device kernel consumes, so the hot path does no numpy fancy
 // indexing at all.  Outputs (length T, caller-allocated):
-//   dur_s[i]   duration of sorted task i
-//   heavy_s[i] heaviest dep of sorted task i as a SORTED index (-1 none)
-//   xp_s[i]    transfer seconds if co-located with the heavy dep
-//   xa_s[i]    transfer seconds if placed anywhere else
+//   dur_s[i]    duration of sorted task i
+//   heavy_s[i]  heaviest dep of sorted task i as a SORTED index (-1 none)
+//   heavy2_s[i] second-heaviest dep as a SORTED index (-1 none)
+//   xp_s[i]     transfer seconds if co-located with the heavy dep
+//   xp2_s[i]    transfer seconds if co-located with the 2nd-heaviest dep
+//   xa_s[i]     transfer seconds if placed anywhere else
 // plus level/perm/offsets as in graphpack.
 int64_t graphpack_full(
     int64_t T, int64_t E,
@@ -125,13 +139,14 @@ int64_t graphpack_full(
     const int32_t* src, const int32_t* dst,
     double inv_bandwidth,
     int32_t* level, int32_t* perm, int32_t* offsets,
-    float* dur_s, int32_t* heavy_s, float* xp_s, float* xa_s)
+    float* dur_s, int32_t* heavy_s, int32_t* heavy2_s,
+    float* xp_s, float* xp2_s, float* xa_s)
 {
-    std::vector<int32_t> heavy(T);
+    std::vector<int32_t> heavy(T), heavy2(T);
     std::vector<float> dep_total(T);
     int64_t n_levels = graphpack(T, E, out_bytes, src, dst,
-                                 level, perm, heavy.data(), dep_total.data(),
-                                 offsets);
+                                 level, perm, heavy.data(), heavy2.data(),
+                                 dep_total.data(), offsets);
     if (n_levels < 0) return -1;
     std::vector<int32_t> inv(T);
     for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
@@ -140,10 +155,14 @@ int64_t graphpack_full(
         int32_t t = perm[i];
         dur_s[i] = durations[t];
         int32_t h = heavy[t];
+        int32_t h2 = heavy2[t];
         heavy_s[i] = h >= 0 ? inv[h] : -1;
+        heavy2_s[i] = h2 >= 0 ? inv[h2] : -1;
         float hb = h >= 0 ? out_bytes[h] : 0.0f;
+        float h2b = h2 >= 0 ? out_bytes[h2] : 0.0f;
         xa_s[i] = dep_total[t] * ibw;
         xp_s[i] = (dep_total[t] - hb) * ibw;
+        xp2_s[i] = (dep_total[t] - h2b) * ibw;
     }
     return n_levels;
 }

@@ -63,7 +63,9 @@ class PackedGraph(NamedTuple):
     n_levels: int
     duration_s: np.ndarray  # f32[T] estimated runtime, sorted order
     heavy_s: np.ndarray     # i32[T] heaviest dep as a SORTED index (-1 none)
+    heavy2_s: np.ndarray    # i32[T] 2nd-heaviest dep, SORTED index (-1 none)
     xfer_pref_s: np.ndarray  # f32[T] transfer seconds if co-located w/ heavy dep
+    xfer_pref2_s: np.ndarray  # f32[T] ... if co-located w/ 2nd-heaviest dep
     xfer_all_s: np.ndarray   # f32[T] transfer seconds if placed anywhere else
 
     @property
@@ -86,12 +88,16 @@ def _pack_numpy(durations, out_bytes, src, dst):
     src_bytes = out_bytes[src] if E else np.zeros(0, np.float32)
     np.add.at(dep_total, dst, src_bytes)
     heavy = np.full(T, -1, np.int64)
+    heavy2 = np.full(T, -1, np.int64)
     if E:
         order = np.lexsort((src, -src_bytes, dst))
         dsorted = dst[order]
         first = np.ones(E, bool)
         first[1:] = dsorted[1:] != dsorted[:-1]
         heavy[dsorted[first]] = src[order][first]
+        second = np.zeros(E, bool)
+        second[1:] = first[:-1] & ~first[1:]
+        heavy2[dsorted[second]] = src[order][second]
 
     # CSR adjacency grouped by src so each level touches only the
     # frontier's own out-edges (O(T+E) overall like graphpack.cpp, not
@@ -135,8 +141,8 @@ def _pack_numpy(durations, out_bytes, src, dst):
         raise ValueError("graph has a cycle: %d tasks never became ready"
                          % (T - placed))
     perm = np.concatenate(perm_parts) if perm_parts else np.zeros(0, np.int32)
-    return level, perm, heavy.astype(np.int32), dep_total.astype(np.float32), \
-        np.asarray(offsets, np.int32), lvl
+    return level, perm, heavy.astype(np.int32), heavy2.astype(np.int32), \
+        dep_total.astype(np.float32), np.asarray(offsets, np.int32), lvl
 
 
 def pack_graph(
@@ -145,11 +151,18 @@ def pack_graph(
     src: np.ndarray,
     dst: np.ndarray,
     bandwidth: float = 100e6,
+    latency: float = 0.001,
 ) -> PackedGraph:
     """O(T+E) pack: levels + heavy deps + transfer costs, level-sorted.
 
     ``src[i] -> dst[i]`` means dst depends on src.  Uses the native C++
     pass when available (~10x the numpy fallback at 1M tasks).
+
+    ``latency`` is the per-remote-dependency round-trip cost added to the
+    transfer model: without it, tiny-payload graphs look free to scatter
+    and the placer shreds producer-consumer locality that the per-fetch
+    RPC cost makes expensive in practice.  Co-location with the heavy
+    dep saves one latency; any other placement pays one per dependency.
     """
     from distributed_tpu import native
 
@@ -159,6 +172,9 @@ def pack_graph(
     dst = np.ascontiguousarray(dst, np.int32)
     T = len(durations)
     E = len(src)
+    indeg = np.zeros(T, np.float32)
+    if E:
+        np.add.at(indeg, dst[(dst >= 0) & (dst < T)], 1.0)
 
     lib = native.load()
     if lib is not None and T:
@@ -167,7 +183,9 @@ def pack_graph(
         offsets_buf = np.zeros(T + 1, np.int32)
         dur_s = np.empty(T, np.float32)
         heavy_s = np.empty(T, np.int32)
+        heavy2_s = np.empty(T, np.int32)
         xp_s = np.empty(T, np.float32)
+        xp2_s = np.empty(T, np.float32)
         xa_s = np.empty(T, np.float32)
         i32p = ctypes.POINTER(ctypes.c_int32)
         f32p = ctypes.POINTER(ctypes.c_float)
@@ -179,33 +197,53 @@ def pack_graph(
             level.ctypes.data_as(i32p), perm.ctypes.data_as(i32p),
             offsets_buf.ctypes.data_as(i32p),
             dur_s.ctypes.data_as(f32p), heavy_s.ctypes.data_as(i32p),
-            xp_s.ctypes.data_as(f32p), xa_s.ctypes.data_as(f32p),
+            heavy2_s.ctypes.data_as(i32p),
+            xp_s.ctypes.data_as(f32p), xp2_s.ctypes.data_as(f32p),
+            xa_s.ctypes.data_as(f32p),
         )
         if n_levels < 0:
             raise ValueError("graph has a cycle")
+        if latency:
+            indeg_p = indeg[perm]
+            extra = latency * np.maximum(indeg_p - 1.0, 0.0)
+            xp_s += extra
+            xp2_s += extra
+            xa_s += latency * indeg_p
         return PackedGraph(
             perm=perm, level=level,
             offsets=offsets_buf[: n_levels + 1].copy(),
             n_levels=int(n_levels),
-            duration_s=dur_s, heavy_s=heavy_s,
-            xfer_pref_s=xp_s, xfer_all_s=xa_s,
+            duration_s=dur_s, heavy_s=heavy_s, heavy2_s=heavy2_s,
+            xfer_pref_s=xp_s, xfer_pref2_s=xp2_s, xfer_all_s=xa_s,
         )
 
-    level, perm, heavy, dep_total, offsets, n_levels = _pack_numpy(
+    level, perm, heavy, heavy2, dep_total, offsets, n_levels = _pack_numpy(
         durations, out_bytes, src, dst
     )
     inv = np.empty(max(T, 1), np.int32)
     inv[perm] = np.arange(T, dtype=np.int32)
     heavy_p = heavy[perm]
+    heavy2_p = heavy2[perm]
     heavy_s = np.where(heavy_p >= 0, inv[np.maximum(heavy_p, 0)], -1).astype(np.int32)
+    heavy2_s = np.where(heavy2_p >= 0, inv[np.maximum(heavy2_p, 0)], -1).astype(np.int32)
     heavy_bytes = np.where(heavy_p >= 0, out_bytes[np.maximum(heavy_p, 0)], 0.0)
+    heavy2_bytes = np.where(heavy2_p >= 0, out_bytes[np.maximum(heavy2_p, 0)], 0.0)
     dep_total_p = dep_total[perm]
+    indeg_p = indeg[perm]
     inv_bw = np.float32(1.0 / bandwidth)
+    extra = latency * np.maximum(indeg_p - 1.0, 0.0)
     return PackedGraph(
         perm=perm, level=level, offsets=offsets, n_levels=int(n_levels),
-        duration_s=durations[perm], heavy_s=heavy_s,
-        xfer_pref_s=((dep_total_p - heavy_bytes) * inv_bw).astype(np.float32),
-        xfer_all_s=(dep_total_p * inv_bw).astype(np.float32),
+        duration_s=durations[perm], heavy_s=heavy_s, heavy2_s=heavy2_s,
+        xfer_pref_s=(
+            (dep_total_p - heavy_bytes) * inv_bw + extra
+        ).astype(np.float32),
+        xfer_pref2_s=(
+            (dep_total_p - heavy2_bytes) * inv_bw + extra
+        ).astype(np.float32),
+        xfer_all_s=(
+            dep_total_p * inv_bw + latency * indeg_p
+        ).astype(np.float32),
     )
 
 
@@ -220,16 +258,19 @@ def _bucket(n: int, floor: int = 512) -> int:
     return b
 
 
-# assign/load/spans are donated: they thread through every dispatch
+# assign/choices/load/spans are donated: they thread through every dispatch
 @functools.partial(
-    jax.jit, static_argnames=("F", "K"), donate_argnums=(4, 5, 6)
+    jax.jit, static_argnames=("F", "K"), donate_argnums=(6, 7, 8, 9)
 )
 def _place_run(
     dur_g,      # f16[Tp] level-sorted durations (device-resident)
     heavy_g,    # i32[Tp] heavy dep as sorted index
+    heavy2_g,   # i32[Tp] 2nd-heaviest dep as sorted index
     xp_g,       # f16[Tp] transfer cost if co-located with heavy dep
+    xp2_g,      # f16[Tp] transfer cost if co-located with 2nd dep
     xa_g,       # f16[Tp] transfer cost otherwise
     assign,     # i32[Tp] worker per sorted task (-1 = not yet placed)
+    choices,    # i32[Tp] chosen candidate: 0 heavy, 1 heavy2, 2 spread
     load,       # f32[W] cumulative modeled load (spread-ordering fairness)
     spans,      # f32[Lp] per-wave modeled makespan
     offs,       # i32[K] wave starts (sorted order)
@@ -245,23 +286,34 @@ def _place_run(
     threads_f = jnp.maximum(nthreads, 1).astype(jnp.float32)
     w_run = jnp.maximum((running & (nthreads > 0)).sum(), 1).astype(jnp.int32)
     rank = jnp.arange(F, dtype=jnp.int32)
+    INF = jnp.float32(np.inf)
 
     def body(k, carry):
-        assign, load, spans = carry
+        assign, choices, load, spans = carry
         offset = offs[k]
         f = fs[k]
 
         dur = lax.dynamic_slice(dur_g, (offset,), (F,)).astype(jnp.float32)
         heavy = lax.dynamic_slice(heavy_g, (offset,), (F,))
+        heavy2 = lax.dynamic_slice(heavy2_g, (offset,), (F,))
         xp = lax.dynamic_slice(xp_g, (offset,), (F,)).astype(jnp.float32)
+        xp2 = lax.dynamic_slice(xp2_g, (offset,), (F,)).astype(jnp.float32)
         xa = lax.dynamic_slice(xa_g, (offset,), (F,)).astype(jnp.float32)
         valid = rank < f
 
-        # locality choice: worker that produced the heaviest dependency
+        # locality candidates: the workers that produced the two
+        # heaviest dependencies (join-shaped tasks — tensordot, merge —
+        # have two comparable inputs; co-locating with either saves a
+        # fetch, mirroring decide_worker's who_has candidate set,
+        # reference scheduler.py:8550)
         h = jnp.maximum(heavy, 0)
         pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
         p = jnp.maximum(pref, 0)
         pref_ok = (pref >= 0) & running[p]
+        h2 = jnp.maximum(heavy2, 0)
+        pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
+        p2 = jnp.maximum(pref2, 0)
+        pref2_ok = (pref2 >= 0) & running[p2] & (pref2 != pref)
 
         # spread choice: priority-contiguous equal blocks over the
         # least-loaded running workers (integer block math — exact)
@@ -273,29 +325,35 @@ def _place_run(
         slot = jnp.clip(rank // block, 0, W - 1)
         spread = order[slot]
 
+        cands = jnp.stack([p, p2, spread])           # i32[3, F]
+        xfers = jnp.stack([xp, xp2, xa])             # f32[3, F]
+        oks = jnp.stack(
+            [pref_ok, pref2_ok, jnp.ones_like(pref_ok)]
+        )
+
         # Waves execute after their predecessors complete, so cross-wave
         # occupancy has drained (the reference's occupancy likewise drops
         # on task completion, scheduler.py:3264): costs use the AMBIENT
         # occupancy plus within-wave contention, while the spread
         # ordering above uses cumulative load for cross-wave fairness.
-        cost_pref = occ0[p] / threads_f[p] + xp
-        cost_spread = occ0[spread] / threads_f[spread] + xa
-        choose = pref_ok & (cost_pref <= cost_spread)
+        def costs_for(extra_load):
+            base = (occ0[cands] + extra_load) / threads_f[cands] + xfers
+            return jnp.where(oks, base, INF)
+
+        choice = jnp.argmin(costs_for(jnp.zeros((3, F), jnp.float32)), axis=0)
+        tent = jnp.take_along_axis(cands, choice[None], 0)[0]
+        xfer_t = jnp.take_along_axis(xfers, choice[None], 0)[0]
 
         # one Jacobi contention round against the tentative wave load
-        tent = jnp.where(choose, pref, spread)
-        tw = jnp.where(valid, dur + jnp.where(choose, xp, xa), 0.0)
+        tw = jnp.where(valid, dur + xfer_t, 0.0)
         tl = jax.ops.segment_sum(tw, jnp.maximum(tent, 0), num_segments=W)
-        load_p_others = tl[p] - jnp.where(tent == p, tw, 0.0)
-        load_s_others = tl[spread] - jnp.where(tent == spread, tw, 0.0)
-        cost_pref2 = (occ0[p] + load_p_others) / threads_f[p] + xp
-        cost_spread2 = (occ0[spread] + load_s_others) / threads_f[spread] + xa
-        choose = pref_ok & (cost_pref2 <= cost_spread2)
+        others = tl[cands] - jnp.where(cands == tent[None], tw[None], 0.0)
+        choice = jnp.argmin(costs_for(others), axis=0)
 
-        assign_w = jnp.where(choose, pref, spread)
+        assign_w = jnp.take_along_axis(cands, choice[None], 0)[0]
+        xfer = jnp.take_along_axis(xfers, choice[None], 0)[0]
         assign_w = jnp.where(valid & running[assign_w], assign_w, -1)
 
-        xfer = jnp.where(choose, xp, xa)
         work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
         wave_load = jax.ops.segment_sum(
             work, jnp.maximum(assign_w, 0), num_segments=W
@@ -308,18 +366,20 @@ def _place_run(
         # their own wave (arrays are padded past T so the update window
         # never clamps backward)
         assign = lax.dynamic_update_slice(assign, assign_w, (offset,))
-        return assign, load, spans
+        choices = lax.dynamic_update_slice(choices, choice, (offset,))
+        return assign, choices, load, spans
 
     if K == 1:
-        return body(0, (assign, load, spans))
-    return lax.fori_loop(0, K, body, (assign, load, spans))
+        return body(0, (assign, choices, load, spans))
+    return lax.fori_loop(0, K, body, (assign, choices, load, spans))
 
 
 @functools.partial(jax.jit, static_argnames=("T", "wide"), donate_argnums=())
-def _shrink_assignment(assign, T: int, wide: bool):
-    """Drop padding (and narrow to int16 when worker ids fit) on device
-    before the download."""
-    out = assign[:T]
+def _shrink_assignment(assign, choices, T: int, wide: bool):
+    """Drop padding and pack (assignment, choice) into one download:
+    ``(assign+1)*4 + choice`` — int16 when worker ids fit, so the wire
+    cost stays 2 bytes/task on tunneled backends."""
+    out = (assign[:T] + 1) * 4 + jnp.clip(choices[:T], 0, 2)
     return out if wide else out.astype(jnp.int16)
 
 
@@ -329,6 +389,7 @@ class LeveledResult(NamedTuple):
     occupancy: np.ndarray    # f32[W] final modeled load
     n_waves: int
     level: np.ndarray        # i32[T] topological level, original order
+    choice: np.ndarray       # i8[T] 0=heavy-dep 1=2nd-dep 2=spread, orig order
 
 
 def _plan_runs(offsets: np.ndarray) -> list[tuple[int, list[int]]]:
@@ -375,13 +436,16 @@ def place_graph_leveled(
         buf[:T] = arr
         return jax.device_put(buf)
 
-    # 10 bytes/task on the wire
+    # 16 bytes/task on the wire
     dur_g = up(packed.duration_s, 0, np.float16)
     heavy_g = up(packed.heavy_s, 0, np.int32)  # pad 0: safe gather index
+    heavy2_g = up(packed.heavy2_s, 0, np.int32)
     xp_g = up(packed.xfer_pref_s, 0, np.float16)
+    xp2_g = up(packed.xfer_pref2_s, 0, np.float16)
     xa_g = up(packed.xfer_all_s, 0, np.float16)
 
     assign = jnp.full(Tp, -1, jnp.int32)
+    choices = jnp.full(Tp, 2, jnp.int32)
     occ0 = jnp.asarray(np.asarray(occupancy0, np.float32))
     load = occ0 + 0.0  # distinct buffer: load is donated, occ0 is not
     spans = jnp.zeros(Lp, jnp.float32)
@@ -399,20 +463,28 @@ def place_graph_leveled(
             offs[i] = packed.offsets[w]
             fs[i] = sizes[w]
             widxs[i] = w
-        assign, load, spans = _place_run(
-            dur_g, heavy_g, xp_g, xa_g, assign, load, spans,
+        assign, choices, load, spans = _place_run(
+            dur_g, heavy_g, heavy2_g, xp_g, xp2_g, xa_g,
+            assign, choices, load, spans,
             jnp.asarray(offs), jnp.asarray(fs), jnp.asarray(widxs),
             nthreads, running, occ0, F=F, K=K,
         )
 
-    small = _shrink_assignment(assign, T=T, wide=len(load) > 32767)
+    W = len(np.asarray(occupancy0))
+    small = _shrink_assignment(
+        assign, choices, T=T, wide=(W + 1) * 4 + 3 > 32767
+    )
     # single synchronization point: fetch results
-    assign_h = np.asarray(small).astype(np.int32)
+    packed_h = np.asarray(small).astype(np.int32)
+    assign_h = packed_h // 4 - 1
+    choice_h = (packed_h % 4).astype(np.int8)
     spans_h = np.asarray(spans)[:L]
     load_h = np.asarray(load)
 
     assignment = np.full(T, -1, np.int32)
     assignment[packed.perm] = assign_h
+    choice = np.full(T, 2, np.int8)
+    choice[packed.perm] = choice_h
     wave_start = np.concatenate([[0.0], np.cumsum(spans_h)[:-1]]).astype(np.float32)
     start_time = wave_start[np.maximum(packed.level, 0)] if L else np.zeros(T, np.float32)
     return LeveledResult(
@@ -421,6 +493,7 @@ def place_graph_leveled(
         occupancy=load_h,
         n_waves=L,
         level=packed.level,
+        choice=choice,
     )
 
 

@@ -1,0 +1,169 @@
+"""Batched work-stealing decisions on device (the WorkStealing
+co-processor).
+
+The python ``WorkStealing.balance`` (scheduler/stealing.py, mirroring
+reference stealing.py:402-465) walks victims x levels x tasks
+sequentially, re-evaluating occupancy after every move.  This kernel
+batches one balance cycle into K Jacobi rounds of a single jitted
+program over SoA arrays:
+
+per round
+  1. every victim worker nominates its best still-unstolen stealable
+     task (lowest (level, arrival-rank), exactly the python scan order);
+  2. victims are ranked by per-thread load descending, idle thieves
+     ascending, and rank r victim is paired with rank r thief — a
+     parallel matching instead of the python's one-at-a-time argmin;
+  3. each pair applies the reference steal criterion
+     ``occ_thief/nthreads + cost + compute <= occ_victim/nthreads -
+     compute/2`` (reference stealing.py:462-465); accepted moves update
+     occupancy, mark tasks stolen, and refresh the idle set
+     (``occ/nthreads > LATENCY`` retires a thief, reference
+     stealing.py:447).
+
+Because a round's accepted moves touch pairwise-distinct victims and
+thieves, replaying them sequentially in any order reproduces the same
+occupancy trajectory the kernel used — every emitted move satisfies the
+python criterion at its application point (tested in
+tests/test_ops_stealing_amm.py by sequential re-validation against the
+python oracle).
+
+The decisions feed the existing async confirm protocol
+(``move_task_request``) unchanged: the device only batches the
+*selection*, exactly like the placement co-processor batches
+``decide_worker``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tpu.ops.leveled import _bucket
+
+LATENCY = 0.1  # assumed steal round-trip (reference stealing.py:33-37)
+
+_RANK_BITS = 27  # key = level << 27 | rank; level < 16, rank < 2^27
+
+
+class StealBatch(NamedTuple):
+    """SoA view of one balance cycle's stealable tasks + worker fleet."""
+
+    task_victim: np.ndarray   # i32[T] worker index currently holding the task
+    task_key: np.ndarray      # i32[T] (level << 27) | arrival-rank
+    task_cost: np.ndarray     # f32[T] transfer seconds to a thief
+    task_compute: np.ndarray  # f32[T] estimated compute seconds
+    occ: np.ndarray           # f32[W] occupancy
+    nthreads: np.ndarray      # i32[W]
+    idle: np.ndarray          # bool[W] potential thieves
+    running: np.ndarray       # bool[W]
+
+
+def make_key(level: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    return (
+        (level.astype(np.int32) << _RANK_BITS)
+        | np.minimum(rank, (1 << _RANK_BITS) - 1).astype(np.int32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("K",))
+def _steal_rounds(
+    task_victim,   # i32[T]
+    task_key,      # i32[T]
+    task_cost,     # f32[T]
+    task_compute,  # f32[T]
+    occ,           # f32[W]
+    nthreads,      # i32[W]
+    idle,          # bool[W]
+    running,       # bool[W]
+    K: int,
+):
+    T = task_victim.shape[0]
+    W = occ.shape[0]
+    threads = jnp.maximum(nthreads, 1).astype(jnp.float32)
+    idx = jnp.arange(T, dtype=jnp.int32)
+    r = jnp.arange(W, dtype=jnp.int32)
+    IMAX = jnp.int32(2**31 - 1)
+
+    def round_body(_, carry):
+        taken, thief_of, occ, idle = carry
+        # 1. best task per victim (lowest key among unstolen)
+        key = jnp.where(taken[:T], IMAX, task_key)
+        best_key = jax.ops.segment_min(key, task_victim, num_segments=W)
+        is_best = (key == best_key[task_victim]) & (key != IMAX)
+        best_idx = jax.ops.segment_min(
+            jnp.where(is_best, idx, T), task_victim, num_segments=W
+        )
+        has_task = best_idx < T
+
+        # 2. rank-matched pairing: busiest victims with least-loaded thieves
+        vload = occ / threads
+        vic_order = jnp.argsort(
+            jnp.where(has_task & running, -vload, jnp.inf)
+        )
+        thief_order = jnp.argsort(jnp.where(idle & running, vload, jnp.inf))
+        n_vic = (has_task & running).sum()
+        n_th = (idle & running).sum()
+        v = vic_order[r]
+        th = thief_order[r]
+        pair_ok = (r < jnp.minimum(n_vic, n_th)) & (v != th)
+
+        # 3. the reference criterion per pair
+        t = jnp.where(pair_ok, best_idx[v], T)
+        tc = jnp.where(t < T, task_cost[jnp.minimum(t, T - 1)], 0.0)
+        cp = jnp.where(t < T, task_compute[jnp.minimum(t, T - 1)], 0.0)
+        crit = vload[th] + tc + cp <= vload[v] - cp / 2
+        acc = pair_ok & crit
+
+        # apply accepted moves (distinct victims & thieves within a round)
+        occ = occ.at[jnp.where(acc, v, W)].add(-cp, mode="drop")
+        occ = occ.at[jnp.where(acc, th, W)].add(cp + tc, mode="drop")
+        taken = taken.at[jnp.where(acc, t, T)].set(True)
+        thief_of = thief_of.at[jnp.where(acc, t, T)].set(
+            jnp.where(acc, th, -1).astype(jnp.int32)
+        )
+        # a thief that got loaded past LATENCY stops being idle
+        idle = idle & ~((occ / threads) > LATENCY)
+        return taken, thief_of, occ, idle
+
+    taken0 = jnp.zeros(T + 1, bool)
+    thief0 = jnp.full(T + 1, -1, jnp.int32)
+    taken, thief_of, occ, idle = jax.lax.fori_loop(
+        0, K, round_body, (taken0, thief0, occ, idle)
+    )
+    return thief_of[:T], occ
+
+
+def plan_steals(batch: StealBatch, rounds: int = 8) -> np.ndarray:
+    """One balance cycle on device; returns thief worker index per task
+    (-1 = not stolen).
+
+    Task arrays are padded to a power-of-two bucket so repeated cycles
+    (whose stealable count varies every 100 ms) reuse the jit cache
+    instead of recompiling per call.  Padding rows carry the sentinel
+    key INT32_MAX, which ``_steal_rounds`` never nominates."""
+    T = len(batch.task_victim)
+    if T == 0:
+        return np.zeros(0, np.int32)
+    Tp = _bucket(T, floor=64)
+
+    def pad(arr, fill, dtype):
+        buf = np.full(Tp, fill, dtype)
+        buf[:T] = arr
+        return jnp.asarray(buf)
+
+    thief_of, _ = _steal_rounds(
+        pad(batch.task_victim, 0, np.int32),
+        pad(batch.task_key, 2**31 - 1, np.int32),
+        pad(batch.task_cost, 0, np.float32),
+        pad(batch.task_compute, 0, np.float32),
+        jnp.asarray(batch.occ),
+        jnp.asarray(batch.nthreads),
+        jnp.asarray(batch.idle),
+        jnp.asarray(batch.running),
+        K=rounds,
+    )
+    return np.asarray(thief_of)[:T]

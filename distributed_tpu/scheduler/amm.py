@@ -11,9 +11,12 @@ side already closes the loop: acquire -> gather -> add-keys registers the
 replica; remove -> release-worker-data unregisters it.
 
 ``ReduceReplicas`` trims replicas beyond current waiter demand — the
-north-star bin-packing target (a vectorized variant lives in
-``distributed_tpu.ops``).  ``RetireWorker`` evacuates unique data for
-graceful retirement.
+north-star bin-packing target.  With the JAX co-processor enabled and
+enough replicated tasks, the whole round's drop selection runs as one
+device call (``distributed_tpu.ops.amm.plan_drops``: K Jacobi rounds
+peeling replicas off the highest-projected-memory holders); suggestions
+still flow through ``_find_dropper``'s safety guards.  ``RetireWorker``
+evacuates unique data for graceful retirement.
 """
 
 from __future__ import annotations
@@ -224,19 +227,83 @@ class ReduceReplicas(ActiveMemoryManagerPolicy):
     """Drop replicas beyond current waiter demand
     (reference active_memory_manager.py:527)."""
 
+    # below this many replicated tasks a device dispatch costs more than
+    # the python generator it replaces
+    DEVICE_MIN_TASKS = 64
+
+    @staticmethod
+    def _desired(ts: "TaskState") -> int:
+        return max(
+            1,
+            len({
+                waiter.processing_on or waiter
+                for waiter in ts.waiters
+            }) if ts.waiters else 1,
+        )
+
     def run(self) -> Generator[Suggestion, None, None]:
+        from distributed_tpu.scheduler.jax_placement import (
+            device_dispatch_worthwhile,
+        )
+
         state = self.manager.state
-        for ts in list(state.replicated_tasks):
-            desired = max(
-                1,
-                len({
-                    waiter.processing_on or waiter
-                    for waiter in ts.waiters
-                }) if ts.waiters else 1,
-            )
-            ndrop = len(ts.who_has) - desired
+        replicated = list(state.replicated_tasks)
+        if device_dispatch_worthwhile(
+            len(state.workers), len(replicated), self.DEVICE_MIN_TASKS
+        ):
+            try:
+                yield from self._run_device(replicated)
+                return
+            except Exception:
+                logger.exception("device ReduceReplicas failed; python fallback")
+        for ts in replicated:
+            ndrop = len(ts.who_has) - self._desired(ts)
             for _ in range(ndrop):
                 yield ("drop", ts, None)
+
+    def _run_device(self, replicated: list) -> Generator[Suggestion, None, None]:
+        """Whole-round drop selection in one device call
+        (ops/amm.py); each emitted suggestion pins its chosen holder and
+        still passes through _find_dropper's guards."""
+        import numpy as np
+
+        from distributed_tpu.ops import amm as ops_amm
+
+        state = self.manager.state
+        workers = list(state.workers.values())
+        widx = {ws: i for i, ws in enumerate(workers)}
+        W = len(workers)
+        rows = []
+        for ts in replicated:
+            ndrop = len(ts.who_has) - self._desired(ts)
+            if ndrop > 0:
+                rows.append((ts, ndrop))
+        if not rows:
+            return
+        R = len(rows)
+        holders = np.zeros((R, W), bool)
+        excluded = np.zeros((R, W), bool)
+        nbytes = np.zeros(R, np.float32)
+        ndrops = np.zeros(R, np.int32)
+        for r, (ts, ndrop) in enumerate(rows):
+            for ws in ts.who_has:
+                i = widx.get(ws)
+                if i is not None:
+                    holders[r, i] = True
+            for waiter in ts.waiters:
+                pw = waiter.processing_on
+                if pw is not None and pw in widx:
+                    excluded[r, widx[pw]] = True
+            nbytes[r] = ts.get_nbytes()
+            ndrops[r] = ndrop
+        mem = np.asarray(
+            [self.manager.workers_memory.get(ws, ws.nbytes) for ws in workers],
+            np.float32,
+        )
+        for r, w in ops_amm.plan_drops(
+            ops_amm.DropBatch(holders, excluded, nbytes, ndrops, mem)
+        ):
+            yield ("drop", rows[r][0], {workers[w]})
 
 
 class RetireWorker(ActiveMemoryManagerPolicy):

@@ -2,10 +2,11 @@
 
 The north-star integration (BASELINE.json): instead of running the
 python ``decide_worker`` min-loop per task (reference scheduler.py:8550,
-~1 ms/task), the scheduler plans a whole incoming graph in ONE device
-call at ``update_graph`` time — ``ops.wavefront.place_graph`` levelizes
-the DAG and assigns every task with a masked cost-matrix argmin per
-wavefront, entirely inside jit.  The plan is consumed as a per-task hint
+~1 ms/task), the scheduler plans a whole incoming graph in one pass at
+``update_graph`` time — ``ops.leveled`` packs the DAG into topological
+levels with a single O(T+E) native pass and places every wave with
+frontier-sized jitted dispatches, one host sync for the whole graph.
+The plan is consumed as a per-task hint
 by ``decide_worker_non_rootish`` via the ``SchedulerState.placement``
 hook; any deviation (worker died, restrictions, occupancy drift) falls
 back to the python locality oracle, and WorkStealing rebalances
@@ -18,7 +19,9 @@ Toggle via ``scheduler.jax.enabled`` / ``scheduler.jax.min-batch``.
 
 from __future__ import annotations
 
+import asyncio
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING, Any
 
 from distributed_tpu import config
@@ -32,21 +35,56 @@ logger = logging.getLogger("distributed_tpu.jax_placement")
 _DEFAULT_NBYTES = 10_000.0  # cost-model guess for unobserved outputs
 
 
+def device_dispatch_worthwhile(n_workers: int, n_items: int,
+                               min_items: int) -> bool:
+    """Shared gate for every scheduler device-kernel path (placement,
+    stealing, AMM): the co-processor pays off only with enough workers
+    (below ``scheduler.jax.min-workers`` the O(deps) python oracles win)
+    and enough items to amortize a dispatch."""
+    return (
+        bool(config.get("scheduler.jax.enabled"))
+        and n_workers >= max(config.get("scheduler.jax.min-workers"), 2)
+        and n_items >= min_items
+    )
+
+
 class JaxPlacement:
-    """Whole-graph device planner behind the SchedulerState.placement hook."""
+    """Whole-graph device planner behind the SchedulerState.placement hook.
+
+    Planning runs OFF the event loop by default: ``plan_graph`` snapshots
+    the batch into SoA arrays synchronously (cheap) and hands
+    pack+place to a single worker thread, so jit compiles and device
+    round-trips never block scheduling.  The plan is only a hint cache —
+    tasks that reach ``decide_worker`` before the plan lands simply take
+    the python locality oracle, and the plan serves the (much larger)
+    tail of waves that become ready as execution proceeds.  Set
+    ``scheduler.jax.sync-plan`` for deterministic tests.
+    """
 
     def __init__(self, min_batch: int | None = None,
-                 max_batch: int | None = None):
+                 max_batch: int | None = None,
+                 min_workers: int | None = None,
+                 sync: bool | None = None):
         self.min_batch = (
             min_batch if min_batch is not None
             else config.get("scheduler.jax.min-batch")
         )
+        self.min_workers = (
+            min_workers if min_workers is not None
+            else config.get("scheduler.jax.min-workers")
+        )
         self.max_batch = max_batch or 1_000_000
+        self.sync = (
+            sync if sync is not None
+            else bool(config.get("scheduler.jax.sync-plan"))
+        )
         self.plan: dict[Key, str] = {}
         self.plans_computed = 0
         self.plan_hits = 0
         self.plan_misses = 0
+        self.plans_inflight = 0
         self.enabled = True
+        self._executor: ThreadPoolExecutor | None = None
 
     # ------------------------------------------------------------- hooks
 
@@ -55,7 +93,7 @@ class JaxPlacement:
 
     def on_remove_worker(self, state: "SchedulerState", ws: "WorkerState") -> None:
         addr = ws.address
-        self.plan = {k: a for k, a in self.plan.items() if a != addr}
+        self.plan = {k: a for k, a in self.plan.items() if a[0] != addr}
 
     def wants(self, ts: "TaskState") -> bool:
         return self.enabled and ts.key in self.plan
@@ -66,9 +104,10 @@ class JaxPlacement:
         ts: "TaskState",
         valid_workers: "set[WorkerState] | None",
     ) -> "WorkerState | None":
-        addr = self.plan.pop(ts.key, None)
-        if addr is None:
+        entry = self.plan.pop(ts.key, None)
+        if entry is None:
             return None
+        addr, verify_key = entry
         ws = state.workers.get(addr)
         if ws is None or ws not in state.running:
             self.plan_misses += 1
@@ -76,6 +115,16 @@ class JaxPlacement:
         if valid_workers is not None and ws not in valid_workers:
             self.plan_misses += 1
             return None
+        if verify_key is not None:
+            # The kernel chose this worker FOR LOCALITY with a specific
+            # dependency, modeling that dep at its planned location.
+            # Plans are computed off-loop, so early waves may have been
+            # placed by the python oracle elsewhere — verify the dep
+            # actually lives here, else the hint's reasoning is void.
+            dts = state.tasks.get(verify_key)
+            if dts is None or ws not in dts.who_has:
+                self.plan_misses += 1
+                return None
         self.plan_hits += 1
         return ws
 
@@ -114,28 +163,90 @@ class JaxPlacement:
         if len(batch) < self.min_batch or len(batch) > self.max_batch:
             return 0
         workers = [ws for ws in state.workers.values()]
-        if len(workers) < 2:
+        if len(workers) < max(self.min_workers, 2):
             return 0
+        snapshot = self._snapshot(state, batch, workers)
+
         try:
-            plan = self._device_plan(state, batch, workers)
-        except Exception:
-            logger.exception("device planning failed; disabling co-processor")
-            self.enabled = False
-            return 0
-        self.plan.update(plan)
-        self.plans_computed += 1
-        logger.debug("planned %d tasks on device", len(plan))
-        return len(plan)
+            loop = asyncio.get_running_loop() if not self.sync else None
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            try:
+                plan = self._plan_from_arrays(*snapshot)
+            except Exception:
+                logger.exception(
+                    "device planning failed; disabling co-processor"
+                )
+                self.enabled = False
+                return 0
+            self.plan.update(plan)
+            self.plans_computed += 1
+            return len(plan)
 
-    def _device_plan(self, state: "SchedulerState", batch: list,
-                     workers: list) -> dict[Key, str]:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                1, thread_name_prefix="jax-placement"
+            )
+        self.plans_inflight += 1
+        fut = self._executor.submit(self._plan_from_arrays, *snapshot)
+
+        def _done(f):
+            try:
+                plan = f.result()
+            except Exception:
+                logger.exception(
+                    "device planning failed; disabling co-processor"
+                )
+                self.enabled = False
+                plan = None
+            try:
+                loop.call_soon_threadsafe(self._merge, plan, state)
+            except RuntimeError:
+                # loop closed before the plan landed: the merge (and its
+                # inflight decrement) will never run on-loop
+                self.plans_inflight -= 1
+
+        fut.add_done_callback(_done)
+        return 0
+
+    def close(self) -> None:
+        """Release the planning thread (scheduler shutdown)."""
+        self.enabled = False
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    def _merge(self, plan: "dict[Key, tuple] | None",
+               state: "SchedulerState") -> None:
+        """Land an async plan on the loop thread, keeping only hints for
+        tasks still pending — tasks the oracle placed while the plan was
+        computing would otherwise accumulate as dead entries forever
+        (and, with reused pure keys, serve stale hints to later graphs)."""
+        self.plans_inflight -= 1
+        if plan:
+            live = {
+                k: v
+                for k, v in plan.items()
+                if (ts := state.tasks.get(k)) is not None
+                and ts.state in ("released", "waiting", "queued", "no-worker")
+            }
+            if live:
+                self.plan.update(live)
+                self.plans_computed += 1
+                logger.debug(
+                    "planned %d tasks on device (%d already placed)",
+                    len(live), len(plan) - len(live),
+                )
+
+    def _snapshot(self, state: "SchedulerState", batch: list, workers: list):
+        """Synchronous SoA snapshot of the batch + worker fleet (the
+        TaskState graph must not be touched off-loop)."""
         import numpy as np
-
-        from distributed_tpu.ops.placement import pad_to_bucket
-        from distributed_tpu.ops.wavefront import GraphArrays, place_graph
 
         n = len(batch)
         index = {ts.key: i for i, ts in enumerate(batch)}
+        keys = [ts.key for ts in batch]
         durations = np.empty(n, np.float32)
         out_bytes = np.empty(n, np.float32)
         src: list[int] = []
@@ -152,35 +263,52 @@ class JaxPlacement:
                 if j is not None:
                     src.append(j)
                     dst.append(i)
-
-        import jax.numpy as jnp
-
-        g = GraphArrays.from_arrays(
-            durations,
-            out_bytes,
-            np.asarray(src, np.int64),
-            np.asarray(dst, np.int64),
-            pad_tasks=pad_to_bucket(n),
-            pad_edges=pad_to_bucket(max(len(src), 1)),
-        )
-        nthreads = jnp.asarray(
-            [ws.nthreads for ws in workers], jnp.int32
-        )
-        occupancy = jnp.asarray(
-            [ws.occupancy for ws in workers], jnp.float32
-        )
-        running = jnp.asarray(
-            [ws in state.running for ws in workers], bool
-        )
-        result = place_graph(
-            g, nthreads, occupancy, running, bandwidth=state.bandwidth
-        )
-        assignment = np.asarray(result.assignment)[:n]
+        nthreads = np.asarray([ws.nthreads for ws in workers], np.int32)
+        occupancy = np.asarray([ws.occupancy for ws in workers], np.float32)
+        running = np.asarray([ws in state.running for ws in workers], bool)
         addrs = [ws.address for ws in workers]
+        return (
+            keys, durations, out_bytes,
+            np.asarray(src, np.int32), np.asarray(dst, np.int32),
+            nthreads, occupancy, running, addrs, state.bandwidth,
+        )
+
+    @staticmethod
+    def _plan_from_arrays(keys, durations, out_bytes, src, dst, nthreads,
+                          occupancy, running, addrs, bandwidth):
+        """Pack + place on pure arrays — safe to run off-loop.
+
+        Returns ``{key: (addr, verify_dep_key | None)}``: locality-chosen
+        placements carry the dependency whose co-location they assumed so
+        ``decide_worker`` can validate the hint against reality.
+        """
+        import numpy as np
+
+        from distributed_tpu.ops.leveled import pack_graph, place_graph_leveled
+
+        packed = pack_graph(durations, out_bytes, src, dst,
+                            bandwidth=bandwidth)
+        result = place_graph_leveled(packed, nthreads, occupancy, running)
+        assignment = result.assignment
+        nw = len(addrs)
+        n = len(keys)
+        inv = np.empty(max(n, 1), np.int32)
+        inv[packed.perm] = np.arange(n, dtype=np.int32)
+        hs = packed.heavy_s[inv[:n]]
+        h2s = packed.heavy2_s[inv[:n]]
+        horig = np.where(hs >= 0, packed.perm[np.maximum(hs, 0)], -1)
+        h2orig = np.where(h2s >= 0, packed.perm[np.maximum(h2s, 0)], -1)
+        verify = np.where(
+            result.choice == 0, horig,
+            np.where(result.choice == 1, h2orig, -1),
+        )
         return {
-            ts.key: addrs[int(assignment[i])]
-            for i, ts in enumerate(batch)
-            if 0 <= assignment[i] < len(addrs)
+            key: (
+                addrs[int(assignment[i])],
+                keys[int(verify[i])] if verify[i] >= 0 else None,
+            )
+            for i, key in enumerate(keys)
+            if 0 <= assignment[i] < nw
         }
 
     def __repr__(self) -> str:

@@ -222,6 +222,9 @@ class Scheduler(Server):
         logger.info("closing scheduler %s", self.id)
         for pc in self.periodic_callbacks.values():
             pc.stop()
+        placement = self.state.placement
+        if placement is not None and hasattr(placement, "close"):
+            placement.close()
         for ext in self.extensions.values():
             close = getattr(ext, "close", None)
             if close is not None:

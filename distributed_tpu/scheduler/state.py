@@ -663,10 +663,17 @@ class SchedulerState:
                     # waiting.  Kick recompute of the bare deps instead of
                     # crashing (reference scheduler.py:2247-2250 guards the
                     # equivalent invariant behind validate).
+                    # deps already on their way back (a sibling waiter's
+                    # reroute, same cascade) must not be cancelled again
                     return (
                         {
-                            dts.key: "waiting" if dts.state == "released" else "released"
+                            dts.key: (
+                                "waiting" if dts.state == "released" else "released"
+                            )
                             for dts in ts.waiting_on
+                            if dts.state not in (
+                                "waiting", "queued", "no-worker", "processing"
+                            )
                         },
                         {},
                         {},
@@ -990,14 +997,22 @@ class SchedulerState:
             return {}, {}, worker_msgs
         if ts.waiting_on:
             # bare-dep reroute (see _transition_waiting_processing): move back
-            # to waiting and recompute the deps whose replicas vanished
+            # to waiting and recompute the deps whose replicas vanished —
+            # skipping deps already on their way back (same filter as the
+            # waiting-path branch: a sibling's reroute must not cancel an
+            # in-flight recompute)
             del self.unrunnable[ts]
             ts.state = "waiting"
             self._count_transition(ts, "no-worker", "waiting")
             return (
                 {
-                    dts.key: "waiting" if dts.state == "released" else "released"
+                    dts.key: (
+                        "waiting" if dts.state == "released" else "released"
+                    )
                     for dts in ts.waiting_on
+                    if dts.state not in (
+                        "waiting", "queued", "no-worker", "processing"
+                    )
                 },
                 {},
                 {},

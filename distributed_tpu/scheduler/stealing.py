@@ -10,7 +10,11 @@ executing there — fenced by stimulus ids (reference stealing.py:279,333).
 
 The inner (victim, level, thief) selection is a pure function over
 occupancy/cost arrays; ``distributed_tpu.ops.stealing`` provides the
-batched device variant used when the JAX co-processor is enabled.
+batched device variant (K Jacobi rounds of rank-matched victim/thief
+pairing under the same steal criterion), used when the JAX co-processor
+is enabled, the fleet is at least ``scheduler.jax.min-workers``, and the
+cycle has enough stealable tasks to amortize a device dispatch.  Either
+path feeds the same async confirm protocol.
 """
 
 from __future__ import annotations
@@ -129,8 +133,9 @@ class WorkStealing:
         (reference stealing.py:241)."""
         if not ts.dependencies:
             return 0, 0
-        if ts.worker_restrictions or ts.host_restrictions or ts.resource_restrictions:
-            return None, None
+        # restrictions are NOT filtered here: _get_thief restricts the
+        # candidate set (with the loose-restrictions fallback), matching
+        # reference stealing.py:530-541
         if ts.actor:
             return None, None
         compute_time = self.state.get_task_duration(ts)
@@ -241,6 +246,10 @@ class WorkStealing:
 
     # ------------------------------------------------------------ balance
 
+    # below this many stealable tasks a device dispatch costs more than
+    # the python scan it replaces
+    DEVICE_MIN_TASKS = 64
+
     def balance(self) -> None:
         """One stealing cycle (reference stealing.py:402)."""
         s = self.state
@@ -249,6 +258,20 @@ class WorkStealing:
         idle_workers = [ws for ws in s.idle.values() if ws in s.running]
         if not idle_workers:
             return
+        from distributed_tpu.scheduler.jax_placement import (
+            device_dispatch_worthwhile,
+        )
+
+        if device_dispatch_worthwhile(
+            len(s.workers),
+            sum(len(t) for levels in self.stealable.values() for t in levels),
+            self.DEVICE_MIN_TASKS,
+        ):
+            try:
+                self._balance_device(idle_workers)
+                return
+            except Exception:
+                logger.exception("device balance failed; python fallback")
         if s.saturated:
             victims = list(s.saturated)
         else:
@@ -293,16 +316,113 @@ class WorkStealing:
             if time() - start > 0.05:  # bound cycle time like the reference
                 break
 
+    # bounds for one device cycle, mirroring the python path's top-10
+    # victims + 0.05 s cycle cap (reference stealing.py:402): the SoA
+    # snapshot python-loop runs on the event loop and must stay O(bounded)
+    DEVICE_MAX_VICTIMS = 32
+    DEVICE_MAX_TASKS = 8192
+
+    def _balance_device(self, idle_workers: list) -> None:
+        """One balance cycle via the device kernel (ops/stealing.py):
+        SoA snapshot -> K-round jitted selection -> the same
+        move_task_request confirm protocol, with per-move safety
+        re-checks (restrictions, liveness) on the way out."""
+        import numpy as np
+
+        from distributed_tpu.ops import stealing as ops_stealing
+        from distributed_tpu.ops.stealing import _RANK_BITS
+
+        max_rank = (1 << _RANK_BITS) - 1
+        s = self.state
+        workers = list(s.workers.values())
+        widx = {ws.address: i for i, ws in enumerate(workers)}
+        idle_set = set(idle_workers)
+
+        if s.saturated:
+            victim_addrs = [ws.address for ws in s.saturated]
+        else:
+            victim_addrs = [
+                ws.address
+                for ws in sorted(
+                    (w for w in workers if w.processing and w not in idle_set),
+                    key=lambda w: w.occupancy / max(w.nthreads, 1),
+                    reverse=True,
+                )
+            ]
+        victim_addrs = victim_addrs[: self.DEVICE_MAX_VICTIMS]
+
+        tasks: list = []
+        victim_idx: list[int] = []
+        keys: list[int] = []
+        costs: list[float] = []
+        computes: list[float] = []
+        rank = 0
+        for addr in victim_addrs:
+            levels = self.stealable.get(addr)
+            vi = widx.get(addr)
+            if levels is None or vi is None:
+                continue
+            if rank >= self.DEVICE_MAX_TASKS:
+                break
+            for level, tset in enumerate(levels):
+                for ts in list(tset):
+                    if rank >= self.DEVICE_MAX_TASKS:
+                        break
+                    if ts.key in self.in_flight or ts.processing_on is None \
+                            or ts.processing_on.address != addr:
+                        tset.discard(ts)
+                        continue
+                    compute = s.get_task_duration(ts)
+                    nbytes = sum(d.get_nbytes() for d in ts.dependencies)
+                    tasks.append(ts)
+                    victim_idx.append(vi)
+                    keys.append((level << _RANK_BITS) | min(rank, max_rank))
+                    costs.append(nbytes / s.bandwidth + LATENCY)
+                    computes.append(compute)
+                    rank += 1
+        if not tasks:
+            return
+        batch = ops_stealing.StealBatch(
+            task_victim=np.asarray(victim_idx, np.int32),
+            task_key=np.asarray(keys, np.int32),
+            task_cost=np.asarray(costs, np.float32),
+            task_compute=np.asarray(computes, np.float32),
+            occ=np.asarray(
+                [self._combined_occupancy(ws) for ws in workers], np.float32
+            ),
+            nthreads=np.asarray([ws.nthreads for ws in workers], np.int32),
+            idle=np.asarray([ws in idle_set for ws in workers], bool),
+            running=np.asarray([ws in s.running for ws in workers], bool),
+        )
+        thief_of = ops_stealing.plan_steals(batch)
+        for ts, ti in zip(tasks, thief_of):
+            if ti < 0:
+                continue
+            thief = workers[int(ti)]
+            victim = ts.processing_on
+            if victim is None or ts.key in self.in_flight:
+                continue
+            if thief not in s.running:
+                continue
+            valid = s.valid_workers(ts)
+            if valid is not None and thief not in valid \
+                    and not ts.loose_restrictions:
+                continue
+            self.move_task_request(ts, victim, thief)
+
     def _combined_occupancy(self, ws: "WorkerState") -> float:
         return ws.occupancy + self.in_flight_occupancy[ws]
 
     def _get_thief(self, ts: "TaskState",
                    idle_workers: list) -> "WorkerState | None":
         valid = self.state.valid_workers(ts)
+        candidates = idle_workers
         if valid is not None:
-            candidates = [ws for ws in idle_workers if ws in valid]
-        else:
-            candidates = idle_workers
+            restricted = [ws for ws in idle_workers if ws in valid]
+            if restricted:
+                candidates = restricted
+            elif not ts.loose_restrictions:
+                return None
         if not candidates:
             return None
         return min(

@@ -31,6 +31,11 @@ from typing import Any, Awaitable, Callable
 logger = logging.getLogger("distributed_tpu.shuffle")
 
 
+class ShuffleClosedError(RuntimeError):
+    """The shuffle run (or one of its buffers) was torn down; task bodies
+    catch this and request an epoch restart (shuffle/api.py)."""
+
+
 class ResourceLimiter:
     """Async budget meter: ``acquire`` blocks while over the limit
     (reference shuffle/_limiter.py:89 semantics)."""
@@ -116,7 +121,7 @@ class ShardsBuffer:
         if self._exception is not None:
             raise self._exception
         if self.closed:
-            raise RuntimeError("buffer closed")
+            raise ShuffleClosedError("buffer closed")
         total = 0
         for id, shards in data.items():
             if not shards:
@@ -134,6 +139,13 @@ class ShardsBuffer:
         self.limiter.book(total)
         self._wake.set()
         await self.limiter.wait_free()
+        # the buffer may have been torn down while we were blocked on
+        # backpressure (epoch restart, run TTL): fail rather than report
+        # shards accepted that were in fact dropped
+        if self._exception is not None:
+            raise self._exception
+        if self.closed:
+            raise ShuffleClosedError("buffer closed while writing")
 
     async def _drain_loop(self) -> None:
         while True:
@@ -171,6 +183,8 @@ class ShardsBuffer:
         await self._done.wait()
         if self._exception is not None:
             raise self._exception
+        if self.closed:
+            raise ShuffleClosedError("buffer closed")
 
     async def close(self) -> None:
         self.closed = True
@@ -182,8 +196,19 @@ class ShardsBuffer:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        self._tasks = []
+        # shards booked but never drained: release their budget so
+        # writers blocked on backpressure wake up (and then observe
+        # `closed` and raise), and unblock any flush() waiters — without
+        # this, a transfer body awaiting wait_free() on a torn-down run
+        # sleeps forever, wedging its execution slot (the round-3
+        # mid-shuffle worker-loss hang)
+        pending = sum(self.sizes.values())
         self.shards.clear()
         self.sizes.clear()
+        if pending:
+            self.limiter.release(pending)
+        self._done.set()
 
 
 class MemoryShardsBuffer(ShardsBuffer):

@@ -43,13 +43,10 @@ from distributed_tpu.shuffle.buffers import (
     DiskShardsBuffer,
     MemoryShardsBuffer,
     ResourceLimiter,
+    ShuffleClosedError,
 )
 
 logger = logging.getLogger("distributed_tpu.shuffle")
-
-
-class ShuffleClosedError(RuntimeError):
-    pass
 
 
 class ShuffleSpec:

@@ -200,6 +200,11 @@ class ShuffleSchedulerExtension:
         """Participating worker died: every shuffle it owned outputs for
         or held transfer state for restarts under a new epoch
         (reference _scheduler_plugin.py:344)."""
+        if self.scheduler.status.name in ("closing", "closed"):
+            # cluster shutdown: workers leave one by one — restarting
+            # each active shuffle per departure is noise, not recovery
+            self.active.clear()
+            return
         for st in list(self.active.values()):
             if address in st.all_workers:
                 self._restart(st, f"lost worker {address}")

@@ -105,8 +105,13 @@ class Worker(Server):
             data=data,
         )
         self.data = self.state.data
+        # unique prefix per worker: the statistical profiler samples by
+        # thread-name match, and with many in-process workers
+        # (LocalCluster) each profiler must see only ITS OWN executor
+        # threads — a shared prefix makes sampling O(workers^2)
+        self._exec_prefix = f"dtpu-worker-exec-{id(self):x}"
         self.executor = ThreadPoolExecutor(
-            self.nthreads, thread_name_prefix="dtpu-worker-exec"
+            self.nthreads, thread_name_prefix=self._exec_prefix
         )
         # actors serialize state access on their own single thread
         # (reference worker.py "actor" executor)
@@ -160,7 +165,7 @@ class Worker(Server):
         if config.get("worker.profile.enabled"):
             from distributed_tpu.diagnostics.profile import Profiler
 
-            self.profiler = Profiler()
+            self.profiler = Profiler(thread_filter=self._exec_prefix)
         self.memory_manager = None
         if memory_limit:
             from distributed_tpu.worker.memory import WorkerMemoryManager
@@ -602,7 +607,13 @@ class Worker(Server):
     async def _execute(self, key: Key, stimulus_id: str) -> StateMachineEvent | None:
         """Run one task (reference worker.py:2210)."""
         ts = self.state.tasks.get(key)
-        if ts is None or ts.state not in ("executing", "long-running", "cancelled"):
+        # "resumed" must run too: if the task was cancelled and re-requested
+        # BEFORE this coroutine's first tick (busy loop), bailing out here
+        # would leave it in "resumed" forever — no execution exists to
+        # complete it (the round-3 mid-shuffle restart hang)
+        if ts is None or ts.state not in (
+            "executing", "long-running", "cancelled", "resumed"
+        ):
             return None
         run_spec = ts.run_spec
         start = time()

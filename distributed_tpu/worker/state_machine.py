@@ -619,18 +619,37 @@ class WorkerState:
         instr: Instructions = []
         self._gather_finished(ev.worker)
         received = set(ev.data)
+        stored: list[Key] = []
         for key, value in ev.data.items():
             ts = self.tasks.get(key)
-            if ts is None or ts.state != "flight":
-                # unsolicited data: keep it if someone may want it, else drop
+            if ts is None or ts.state not in ("flight", "resumed"):
+                # unsolicited data (e.g. the fetch was cancelled mid-
+                # flight): drop it — and do NOT announce it, or the
+                # scheduler would record a phantom replica here that
+                # peers then try to fetch forever (livelock)
                 if ts is not None and ts.state == "cancelled":
                     recs[ts] = "released"
                 continue
+            # "resumed": the fetch was cancelled then the key re-requested
+            # as a compute — the arrived value satisfies it directly; no
+            # Execute exists to complete it otherwise (wedge)
+            if ts.state == "resumed":
+                self.in_flight_tasks.discard(ts)
+                ts.coming_from = None
+                # resumed -> memory emits TaskFinishedMsg, which already
+                # registers the replica — no AddKeysMsg needed
+                self.data[key] = value
+                recs[ts] = "memory"
+                continue
             self.data[key] = value
+            stored.append(key)
             recs[ts] = "memory"
-        if received:
-            instr.append(AddKeysMsg(stimulus_id=ev.stimulus_id, keys=tuple(received)))
-        # keys requested but not received: the peer no longer has them
+        if stored:
+            instr.append(AddKeysMsg(stimulus_id=ev.stimulus_id, keys=tuple(stored)))
+        # keys requested but not received: the peer no longer has them.
+        # Tell the scheduler (missing-data) so it drops the stale replica
+        # from who_has — otherwise refresh-who-has keeps pointing us back
+        # at the same errant peer (reference scheduler.py handle_missing_data)
         requested = self.in_flight_workers.pop(ev.worker, set())
         for key in requested - received:
             ts = self.tasks.get(key)
@@ -640,6 +659,11 @@ class WorkerState:
             ts.coming_from = None
             ts.who_has.discard(ev.worker)
             self.has_what[ev.worker].discard(key)
+            instr.append(
+                MissingDataMsg(
+                    stimulus_id=ev.stimulus_id, key=key, errant_worker=ev.worker
+                )
+            )
             if ts.state == "flight":
                 recs[ts] = "fetch" if ts.who_has else "missing"
             elif ts.state in ("cancelled", "resumed"):

@@ -19,7 +19,7 @@ def inc(x):
 
 @gen_test(timeout=120)
 async def test_plan_consumed_and_results_correct():
-    placement = JaxPlacement(min_batch=4)
+    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True)
     async with LocalCluster(
         n_workers=2,
         scheduler_kwargs={"validate": True, "placement": placement},
@@ -48,8 +48,47 @@ async def test_plan_consumed_and_results_correct():
 
 
 @gen_test(timeout=120)
+async def test_async_plan_lands_mid_execution():
+    """Default (async) planning: the device plan is computed off-loop and
+    serves the waves that become ready after it lands; early tasks fall
+    back to the python oracle with no loop stall."""
+    import time as _time
+
+    placement = JaxPlacement(min_batch=4, min_workers=0)
+    assert not placement.sync
+
+    def slow_inc(x):
+        _time.sleep(0.3)
+        return x + 1
+
+    async with LocalCluster(
+        n_workers=2,
+        scheduler_kwargs={"validate": True, "placement": placement},
+        worker_kwargs={"validate": True},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+
+            g = Graph()
+            keys = []
+            for i in range(6):
+                g.tasks[f"asrc{i}-x"] = TaskSpec(slow_inc, (i,))
+                g.tasks[f"aout{i}-x"] = TaskSpec(inc, (TaskRef(f"asrc{i}-x"),))
+                keys.append(f"aout{i}-x")
+            futs = c.compute_graph(g, keys)
+            results = await asyncio.wait_for(
+                c.gather([futs[k] for k in keys]), 60
+            )
+            assert results == [i + 2 for i in range(6)]
+            # plan landed off-loop (0.3 s of slack) and the second layer
+            # consumed it
+            assert placement.plans_computed >= 1
+            assert placement.plan_hits > 0
+
+
+@gen_test(timeout=120)
 async def test_plan_fallback_when_worker_dies():
-    placement = JaxPlacement(min_batch=4)
+    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True)
     async with LocalCluster(
         n_workers=2,
         scheduler_kwargs={"validate": True, "placement": placement},
@@ -73,7 +112,8 @@ async def test_plan_fallback_when_worker_dies():
             await victim.close(report=False)
             cluster.workers = cluster.workers[1:]
             assert all(
-                addr != victim.address for addr in placement.plan.values()
+                addr != victim.address
+                for addr, _ in placement.plan.values()
             )
             futs2 = c.map(inc, range(8), pure=False)
             assert await asyncio.wait_for(c.gather(futs2), 60) == list(

@@ -51,7 +51,7 @@ def test_pack_native_matches_numpy_fallback():
     rng = np.random.default_rng(1)
     durations, out_bytes, src, dst = random_dag(rng, 3000)
     packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
-    lv, perm, heavy, dep_total, offsets, L = _pack_numpy(
+    lv, perm, heavy, heavy2, dep_total, offsets, L = _pack_numpy(
         durations, out_bytes, src.astype(np.int64), dst.astype(np.int64)
     )
     assert packed.n_levels == L
@@ -64,10 +64,24 @@ def test_pack_native_matches_numpy_fallback():
     np.testing.assert_array_equal(
         packed.heavy_s, np.where(hp >= 0, inv[np.maximum(hp, 0)], -1)
     )
+    h2p = heavy2[perm]
+    np.testing.assert_array_equal(
+        packed.heavy2_s, np.where(h2p >= 0, inv[np.maximum(h2p, 0)], -1)
+    )
+    indeg = np.zeros(3000, np.float32)
+    np.add.at(indeg, dst[(src != dst)], 1.0)
     np.testing.assert_allclose(
-        packed.xfer_all_s, dep_total[perm] / BW, rtol=1e-5
+        packed.xfer_all_s,
+        dep_total[perm] / BW + 0.001 * indeg[perm],
+        rtol=1e-5, atol=1e-7,
     )
     np.testing.assert_array_equal(packed.duration_s, durations[perm])
+    # latency=0 strips the per-dependency round-trip term
+    packed0 = pack_graph(durations, out_bytes, src, dst, bandwidth=BW,
+                         latency=0.0)
+    np.testing.assert_allclose(
+        packed0.xfer_all_s, dep_total[perm] / BW, rtol=1e-5
+    )
 
 
 def test_pack_levels_are_topological():

@@ -310,3 +310,105 @@ def test_story(ws):
 def test_deterministic_stimulus_log(ws):
     ws.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
     assert len(ws.stimulus_log) == 1
+
+
+def test_cancelled_flight_data_not_announced(ws):
+    """A fetch cancelled mid-flight whose data still arrives must NOT send
+    AddKeysMsg: the value is dropped, and announcing it would plant a
+    phantom replica in the scheduler that peers then fetch forever (the
+    round-3 tensordot livelock)."""
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "y", priority=(0,),
+            who_has={"dep": ["tcp://peer:1"]}, nbytes={"dep": 100},
+        )
+    )
+    assert ws.tasks["dep"].state == "flight"
+    # scheduler frees the dependent -> dep fetch is cancelled mid-flight
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="s-free", keys=("y", "dep")))
+    assert ws.tasks["dep"].state == "cancelled"
+    instrs = ws.handle_stimulus(
+        GatherDepSuccessEvent(
+            stimulus_id="s-gd", worker="tcp://peer:1", data={"dep": 7},
+            total_nbytes=100,
+        )
+    )
+    assert not any(isinstance(i, AddKeysMsg) for i in instrs)
+    assert "dep" not in ws.data
+
+
+def test_gather_success_missing_key_notifies_scheduler(ws):
+    """Requested-but-not-received keys must emit MissingDataMsg so the
+    scheduler drops the stale replica — otherwise refresh-who-has keeps
+    pointing this worker back at the same errant peer (livelock)."""
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "y", priority=(0,),
+            who_has={"dep": ["tcp://peer:1"], "dep2": ["tcp://peer:1"]},
+            nbytes={"dep": 100, "dep2": 100},
+        )
+    )
+    assert ws.tasks["dep"].state == "flight"
+    assert ws.tasks["dep2"].state == "flight"
+    # peer serves only dep2: it no longer holds dep
+    instrs = ws.handle_stimulus(
+        GatherDepSuccessEvent(
+            stimulus_id="s-gd", worker="tcp://peer:1", data={"dep2": 7},
+            total_nbytes=100,
+        )
+    )
+    md = [i for i in instrs if isinstance(i, MissingDataMsg)]
+    assert [m.key for m in md] == ["dep"]
+    assert md[0].errant_worker == "tcp://peer:1"
+    # no replicas left anywhere -> missing (find_missing will refresh)
+    assert ws.tasks["dep"].state == "missing"
+    assert ws.tasks["dep2"].state == "memory"
+
+
+def test_compute_cancel_recompute_before_first_tick():
+    """Server-level race: Execute instruction issued, but the task is
+    released AND re-requested before the _execute coroutine's first tick.
+    The (single) execution must still run and complete the resumed task —
+    bailing out for state=='resumed' wedges the task forever (the
+    round-3 mid-shuffle restart hang)."""
+    import asyncio
+
+    from distributed_tpu.worker.server import Worker
+
+    async def main():
+        from distributed_tpu.rpc.core import Status
+
+        w = Worker.__new__(Worker)  # bare worker: no comms needed
+        from distributed_tpu.worker.state_machine import WorkerState as WS
+
+        w.state = WS(nthreads=1, address="tcp://self:1", validate=True)
+        w.state.running = True
+        w.data = w.state.data
+        w._async_instructions = set()
+        w.status = Status.running
+        from concurrent.futures import ThreadPoolExecutor
+
+        w.executor = ThreadPoolExecutor(1)
+        w.batched_stream = type(
+            "B", (), {"send": staticmethod(lambda msg: None)}
+        )()
+        w.digest_metric = lambda name, value: None
+
+        # 1. compute-task -> Execute instruction (coroutine created but
+        #    not yet ticked)
+        w.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+        assert w.state.tasks["x"].state == "executing"
+        # 2. released then re-requested BEFORE the loop runs the coroutine
+        w.handle_stimulus(FreeKeysEvent(stimulus_id="s-free", keys=("x",)))
+        assert w.state.tasks["x"].state == "cancelled"
+        w.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
+        assert w.state.tasks["x"].state == "resumed"
+        # 3. let the coroutine run: it must execute and complete the task
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if w.state.tasks["x"].state == "memory":
+                break
+        assert w.state.tasks["x"].state == "memory", w.state.tasks["x"].state
+        w.executor.shutdown(wait=False)
+
+    asyncio.run(main())
