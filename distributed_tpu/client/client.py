@@ -167,6 +167,7 @@ class Client:
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
         self.futures: dict[Key, FutureState] = {}
         self.refcount: dict[Key, int] = {}
+        self._cancel_expected: dict[Key, "FutureState"] = {}
         self.scheduler_comm: Comm | None = None
         self.batched_stream = BatchedSend(interval=0.002)
         self.scheduler: rpc | None = None
@@ -285,8 +286,16 @@ class Client:
                         self._handle_lost_data(**msg)
                     elif op == "cancelled-keys":
                         for key in msg.get("keys", ()):
+                            # the state was already cancelled synchronously
+                            # in Client.cancel; this report arrives over
+                            # the batched stream and may postdate a
+                            # RESUBMISSION of the key — only apply it to
+                            # the FutureState the cancel targeted
+                            expected = self._cancel_expected.pop(key, None)
                             st = self.futures.get(key)
-                            if st is not None:
+                            if st is not None and (
+                                expected is None or st is expected
+                            ):
                                 st.cancel()
                     elif op == "pubsub-msg":
                         for sub in self._pubsub_subs.get(msg.get("name"), ()):
@@ -439,8 +448,15 @@ class Client:
                 key = f"{funcname(fn)}-{tokenize(fn, args, tuple(sorted(kwargs.items())))}"
             else:
                 key = f"{funcname(fn)}-{uuid.uuid4().hex[:16]}"
-        if key in self.futures:
-            return Future(key, self)
+        st = self.futures.get(key)
+        if st is not None:
+            if st.status != "cancelled":
+                return Future(key, self)
+            # resubmission of a cancelled key: replace the stale client
+            # state so a fresh task goes to the scheduler — but KEEP the
+            # refcount: old cancelled Future objects still reference the
+            # key, and their later release must not free the new task
+            del self.futures[key]
         spec_args = _futures_to_refs(args)
         spec_kwargs = _futures_to_refs(kwargs)
         tasks: dict[Key, Any] = {key: TaskSpec(fn, spec_args, spec_kwargs)}
@@ -475,8 +491,14 @@ class Client:
             else:
                 k = f"{prefix}-{uuid.uuid4().hex[:16]}"
             keys.append(k)
-            if k in self.futures or k in tasks:
+            if k in tasks:
                 continue
+            st = self.futures.get(k)
+            if st is not None:
+                if st.status != "cancelled":
+                    continue
+                # same cancelled-key resubmission contract as submit()
+                del self.futures[k]
             tasks[k] = TaskSpec(fn, _futures_to_refs(zargs), _futures_to_refs(kwargs))
         futs = self._graph_to_futures(
             {k: v for k, v in tasks.items()},
@@ -622,6 +644,14 @@ class Client:
 
     async def cancel(self, futures: Iterable[Future], force: bool = False) -> None:
         keys = [f.key for f in futures]
+        # cancel synchronously client-side (reference client.py _cancel):
+        # the scheduler's confirmation rides the batched stream and could
+        # otherwise cancel a future resubmitted in the meantime
+        for k in keys:
+            st = self.futures.get(k)
+            if st is not None:
+                st.cancel()
+                self._cancel_expected[k] = st
         assert self.scheduler is not None
         await self.scheduler.cancel(keys=keys, client=self.id, force=force)
 

@@ -194,12 +194,17 @@ class JaxPlacement:
         def _done(f):
             try:
                 plan = f.result()
-            except Exception:
-                logger.exception(
-                    "device planning failed; disabling co-processor"
-                )
-                self.enabled = False
+            except BaseException as exc:
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
                 plan = None
+                # a future cancelled by close() is a clean shutdown, not
+                # a planning failure
+                if not f.cancelled():
+                    logger.exception(
+                        "device planning failed; disabling co-processor"
+                    )
+                    self.enabled = False
             try:
                 loop.call_soon_threadsafe(self._merge, plan, state)
             except RuntimeError:

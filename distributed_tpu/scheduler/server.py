@@ -349,7 +349,12 @@ class Scheduler(Server):
         try:
             await self.handle_stream(comm, extra={"worker": address})
         finally:
-            await self.remove_worker(address, "stream-closed")
+            try:
+                await self.remove_worker(address, "stream-closed")
+            except Exception:
+                # a failed removal must be loud: half-applied reschedules
+                # strand tasks on a dead worker
+                logger.exception("remove_worker failed for %s", address)
         return Status.dont_reply
 
     async def remove_worker(self, address: str, reason: str = "", *,
@@ -808,13 +813,16 @@ class Scheduler(Server):
         stimulus_id = seq_name("cancel")
         cancelled = []
         for key in keys:
+            # report even for unknown keys: the client registered a
+            # _cancel_expected entry per requested key and consumes it on
+            # this confirmation
+            self.report(
+                {"op": "cancelled-keys", "keys": [key]}, client=client
+            )
             ts = self.state.tasks.get(key)
             if ts is None:
                 continue
             cancelled.append(key)
-            self.report(
-                {"op": "cancelled-keys", "keys": [key]}, client=client
-            )
         client_msgs, worker_msgs = self.state.client_releases_keys(
             cancelled, client, stimulus_id
         )

@@ -623,11 +623,23 @@ class SchedulerState:
                 # dependency irrecoverably gone (e.g. scattered data lost)
                 ts.state = "erred"  # pragma: no cover
                 return recommendations, {}, {}
-            if dts.state != "memory":
+            # replica truth, not task state: mid-cascade (e.g. worker
+            # removal) a dep can be "memory" with an empty who_has while
+            # its own released recommendation is still queued — treating
+            # it satisfied would place this task with a bare dependency
+            # (reference scheduler.py _transition_released_waiting checks
+            # who_has)
+            if not dts.who_has:
                 ts.waiting_on.add(dts)
                 dts.waiters.add(ts)
                 if dts.state == "released":
                     recommendations[dts.key] = "waiting"
+                elif dts.state == "memory":
+                    # last replica vanished while the dep still reads
+                    # "memory" (worker-death race): kick its recompute;
+                    # if a released rec is already queued in this cascade
+                    # the dict merge dedupes it
+                    recommendations[dts.key] = "released"
         ts.state = "waiting"
         self._count_transition(ts, "released", "waiting")
         if not ts.waiting_on:
@@ -1976,7 +1988,11 @@ class SchedulerState:
             assert ts.state in ALL_TASK_STATES or ts.state == "forgotten", ts
 
             for dts in ts.waiting_on:
-                assert dts.state != "memory", (ts, dts)
+                # replica truth: a dep mid-recompute may be state "memory"
+                # transiently, but a task only waits on deps with no
+                # stored replica (reference validate_waiting:
+                # bool(who_has) != (dts in waiting_on))
+                assert not dts.who_has, (ts, dts)
                 assert ts in dts.waiters, (ts, dts)
             for dts in ts.dependencies:
                 assert ts in dts.dependents, (ts, dts)

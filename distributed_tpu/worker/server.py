@@ -666,6 +666,15 @@ class Worker(Server):
         except BaseException as e:  # noqa: B036 - user code may raise anything
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
+            if isinstance(e, asyncio.CancelledError) and self.status in (
+                Status.closing, Status.closed, Status.failed
+            ):
+                # worker shutdown cancelled us: propagate (no task-erred).
+                # A CancelledError leaking from USER code outside shutdown
+                # falls through to the failure path instead — swallowing
+                # it would wedge the task in 'executing' with no
+                # completion event
+                raise
             stop = time()
             e2 = truncate_exception(e)
             return ExecuteFailureEvent(

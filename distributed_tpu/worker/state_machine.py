@@ -492,13 +492,17 @@ class WorkerState:
             ("cancelled", "memory"): self._transition_cancelled_memory,
             ("cancelled", "error"): self._transition_cancelled_error,
             ("cancelled", "rescheduled"): self._transition_cancelled_released,
+            ("cancelled", "waiting"): self._transition_cancelled_waiting,
+            ("cancelled", "fetch"): self._transition_cancelled_fetch,
             # resumed (cancelled then wanted again) execute ending in
             # Reschedule: nothing was produced — tell the scheduler to
             # re-place it, exactly like an executing task would
             ("resumed", "rescheduled"): self._transition_executing_rescheduled,
             ("resumed", "memory"): self._transition_executing_memory,
-            ("resumed", "released"): self._transition_generic_released,
+            ("resumed", "released"): self._transition_resumed_released,
             ("resumed", "error"): self._transition_executing_error,
+            ("resumed", "fetch"): self._transition_resumed_fetch,
+            ("resumed", "missing"): self._transition_resumed_missing,
             ("error", "released"): self._transition_generic_released,
             ("rescheduled", "released"): self._transition_generic_released,
         }
@@ -537,25 +541,25 @@ class WorkerState:
         ts.stimulus_id = ev.stimulus_id
 
         recs: Recs = {}
-        if ts.state in ("memory", "error", "executing", "long-running", "waiting",
+        if ts.state in ("executing", "long-running", "waiting",
                         "ready", "constrained"):
-            # duplicate compute-task: already underway or done
-            if ts.state == "memory":
-                return recs, [
-                    TaskFinishedMsg(
-                        stimulus_id=ev.stimulus_id,
-                        key=ts.key,
-                        nbytes=ts.nbytes,
-                        typename=None,
-                        startstops=(),
-                    )
-                ]
+            # duplicate compute-task: already underway
             return recs, []
-        if ts.state == "cancelled":
-            # scheduler wants it again: resume towards executing
-            ts.state = "resumed"
-            ts.next = "executing"
-            return recs, []
+        if ts.state == "memory":
+            return recs, [
+                TaskFinishedMsg(
+                    stimulus_id=ev.stimulus_id,
+                    key=ts.key,
+                    nbytes=ts.nbytes,
+                    typename=None,
+                    startstops=(),
+                )
+            ]
+        # released / fetch / flight / missing / cancelled / resumed /
+        # error: recommend "waiting" — the cancelled/resumed transitions
+        # (and the through-released fallback) turn interrupted fetches
+        # and executions into resumed-towards-compute
+        # (reference wsm.py:2851-2861)
 
         # wire up dependencies
         for dep_key, workers in ev.who_has.items():
@@ -666,8 +670,15 @@ class WorkerState:
             )
             if ts.state == "flight":
                 recs[ts] = "fetch" if ts.who_has else "missing"
-            elif ts.state in ("cancelled", "resumed"):
+            elif ts.state == "cancelled":
+                ts.done = True
                 recs[ts] = "released"
+            elif ts.state == "resumed":
+                # the fetch ended empty-handed but the scheduler asked for
+                # a compute meanwhile: done=True lets resumed->fetch fall
+                # through released->waiting and run it
+                ts.done = True
+                recs[ts] = "fetch"
         return recs, instr
 
     def _handle_gather_dep_busy(self, ev: GatherDepBusyEvent) -> tuple[Recs, Instructions]:
@@ -683,8 +694,12 @@ class WorkerState:
             ts.coming_from = None
             if ts.state == "flight":
                 recs[ts] = "fetch"
-            elif ts.state in ("cancelled", "resumed"):
+            elif ts.state == "cancelled":
+                ts.done = True
                 recs[ts] = "released"
+            elif ts.state == "resumed":
+                ts.done = True
+                recs[ts] = "fetch"
         return recs, [
             RetryBusyWorkerLater(stimulus_id=ev.stimulus_id, worker=ev.worker)
         ]
@@ -711,8 +726,12 @@ class WorkerState:
             )
             if ts.state == "flight":
                 recs[ts] = "fetch" if ts.who_has else "missing"
-            elif ts.state in ("cancelled", "resumed"):
+            elif ts.state == "cancelled":
+                ts.done = True
                 recs[ts] = "released"
+            elif ts.state == "resumed":
+                ts.done = True
+                recs[ts] = "fetch"
         return recs, instr
 
     def _handle_gather_dep_failure(self, ev: GatherDepFailureEvent) -> tuple[Recs, Instructions]:
@@ -859,21 +878,48 @@ class WorkerState:
     def _transition(
         self, ts: WTaskState, finish: Any, stimulus_id: str, remaining: dict
     ) -> Instructions:
+        recs, instructions = self._do_transition(ts, finish, stimulus_id)
+        remaining.update(recs)
+        return instructions
+
+    def _do_transition(
+        self, ts: WTaskState, finish: Any, stimulus_id: str
+    ) -> tuple[Recs, Instructions]:
         kwargs: dict = {}
         if isinstance(finish, tuple):
             finish, payload = finish
             kwargs["payload"] = payload
         start = ts.state
         if start == finish:
-            return []
-        func = self._transitions_table.get((start, finish))
-        if func is None:
-            raise InvalidTransition(ts.key, start, str(finish), list(self.log))
+            return {}, []
         self.transition_counter += 1
-        recs, instructions = func(ts, stimulus_id=stimulus_id, **kwargs)
-        self.log.append((ts.key, start, ts.state, stimulus_id))
-        remaining.update(recs)
-        return instructions
+        func = self._transitions_table.get((start, finish))
+        if func is not None:
+            recs, instructions = func(ts, stimulus_id=stimulus_id, **kwargs)
+            self.log.append((ts.key, start, ts.state, stimulus_id))
+            return recs, instructions
+        if "released" not in (start, finish):
+            # no direct edge: route start -> released -> finish, replaying
+            # any intermediate recommendations for ts along the way but
+            # never forgetting it (reference wsm.py:2602-2629)
+            recs, instructions = self._do_transition(
+                ts, "released", stimulus_id
+            )
+            while (v := recs.pop(ts, None)) is not None:
+                v_state = v[0] if isinstance(v, tuple) else v
+                if v_state == "forgotten":
+                    continue
+                r2, i2 = self._do_transition(ts, v, stimulus_id)
+                recs.update(r2)
+                instructions += i2
+            r3, i3 = self._do_transition(
+                ts, (finish, kwargs["payload"]) if kwargs else finish,
+                stimulus_id,
+            )
+            recs.update(r3)
+            instructions += i3
+            return recs, instructions
+        raise InvalidTransition(ts.key, start, str(finish), list(self.log))
 
     # ------------------------------------------------------------- handlers
 
@@ -1063,6 +1109,72 @@ class WorkerState:
             return {}, []  # still running; stay cancelled until done
         ts.previous = None
         return self._transition_generic_released(ts, stimulus_id=stimulus_id)
+
+    def _transition_cancelled_waiting(self, ts, *, stimulus_id):
+        """The scheduler wants a cancelled task computed again (reference
+        wsm.py:2157): revert an interrupted execution in place, or mark a
+        cancelled fetch as resumed-towards-compute."""
+        if ts.previous == "executing":
+            ts.state = "executing"  # forget the cancellation entirely
+            ts.previous = None
+            ts.next = None
+            return {}, []
+        if ts.previous == "long-running":
+            ts.state = "long-running"
+            ts.previous = None
+            ts.next = None
+            return {}, [
+                LongRunningMsg(
+                    stimulus_id=stimulus_id, key=ts.key, compute_duration=0.0
+                )
+            ]
+        # previous == "flight": the fetch still runs; compute once it ends
+        ts.state = "resumed"
+        ts.next = "waiting"
+        return {}, []
+
+    def _transition_cancelled_fetch(self, ts, *, stimulus_id):
+        """(reference wsm.py:2130)"""
+        if ts.previous == "flight":
+            if ts.done:
+                return {ts: "released"}, []
+            ts.state = "flight"  # forget the cancellation
+            ts.previous = None
+            return {}, []
+        # previous executing/long-running: keep running; fetch afterwards
+        ts.state = "resumed"
+        ts.next = "fetch"
+        return {}, []
+
+    def _transition_resumed_fetch(self, ts, *, stimulus_id):
+        """(reference wsm.py:2076)"""
+        if ts.previous == "flight":
+            if ts.done:
+                # the old fetch ended without producing the value: honor
+                # the resume-to-compute request
+                ts.state = "released"
+                ts.done = False
+                ts.previous = None
+                ts.next = None
+                return {ts: "waiting"}, []
+            ts.state = "flight"  # back where we started
+            ts.previous = None
+            ts.next = None
+            return {}, []
+        return {}, []  # executing/long-running: completion event decides
+
+    def _transition_resumed_missing(self, ts, *, stimulus_id):
+        return {ts: "fetch"}, []
+
+    def _transition_resumed_released(self, ts, *, stimulus_id):
+        """(reference wsm.py:2120)"""
+        if ts.done:
+            ts.previous = None
+            ts.next = None
+            return self._transition_generic_released(ts, stimulus_id=stimulus_id)
+        ts.state = "cancelled"
+        ts.next = None
+        return {}, []
 
     def _transition_cancelled_memory(self, ts, *, stimulus_id, payload=None):
         # task was cancelled but completed anyway and scheduler re-wants it

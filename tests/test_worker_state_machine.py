@@ -402,7 +402,10 @@ def test_compute_cancel_recompute_before_first_tick():
         w.handle_stimulus(FreeKeysEvent(stimulus_id="s-free", keys=("x",)))
         assert w.state.tasks["x"].state == "cancelled"
         w.handle_stimulus(ComputeTaskEvent.dummy("x", priority=(0,)))
-        assert w.state.tasks["x"].state == "resumed"
+        # the cancellation is forgotten: the task reverts straight to
+        # executing (reference wsm.py:2157) and the original (not yet
+        # ticked) execution must complete it
+        assert w.state.tasks["x"].state == "executing"
         # 3. let the coroutine run: it must execute and complete the task
         for _ in range(100):
             await asyncio.sleep(0.01)

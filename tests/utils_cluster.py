@@ -1,0 +1,179 @@
+"""Deterministic race-test harness (reference utils_test.py:865,2202-2340).
+
+``gen_cluster`` starts Scheduler + N Workers (+ Client) in one event loop
+with ``validate=True`` everywhere, parametrized over comm transports, and
+tears everything down even on failure.  The ``Blocked*`` worker classes
+pause a worker at a chosen point in the data plane so tests can interleave
+events deterministically — the technique the reference uses to pin down
+cancelled/resumed transitions, steal-confirm races, and mid-transfer
+worker deaths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any
+
+import pytest
+
+from distributed_tpu import config
+from distributed_tpu.client.client import Client
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
+
+
+def gen_cluster(
+    nthreads: list[int] | None = None,
+    client: bool = True,
+    timeout: float = 60,
+    worker_cls: Any = None,
+    scheduler_kwargs: dict | None = None,
+    worker_kwargs: dict | None = None,
+    config_overrides: dict | None = None,
+    transports: tuple[str, ...] = ("inproc",),
+):
+    """Decorator: run ``fn(c, s, *workers)`` (or ``fn(s, *workers)`` with
+    ``client=False``) on a fresh cluster per listed transport."""
+    nthreads = nthreads if nthreads is not None else [1, 1]
+    classes = (
+        worker_cls
+        if isinstance(worker_cls, (list, tuple))
+        else [worker_cls] * len(nthreads)
+    )
+
+    def decorator(fn):
+        @pytest.mark.parametrize("transport", list(transports))
+        def wrapper(transport):
+            async def run():
+                overrides = {
+                    "scheduler.jax.enabled": False,
+                    **(config_overrides or {}),
+                }
+                with config.set(overrides):
+                    listen = (
+                        "inproc://" if transport == "inproc"
+                        else "tcp://127.0.0.1:0"
+                    )
+                    s = Scheduler(
+                        listen_addr=listen, validate=True,
+                        **(scheduler_kwargs or {}),
+                    )
+                    await s.start()
+                    workers = []
+                    try:
+                        for i, nt in enumerate(nthreads):
+                            cls = classes[i] or Worker
+                            w = cls(
+                                s.address, name=f"w{i}", nthreads=nt,
+                                validate=True, listen_addr=listen,
+                                **(worker_kwargs or {}),
+                            )
+                            await w.start()
+                            workers.append(w)
+                        if client:
+                            async with Client(s.address) as c:
+                                await asyncio.wait_for(
+                                    fn(c, s, *workers), timeout
+                                )
+                        else:
+                            await asyncio.wait_for(fn(s, *workers), timeout)
+                    finally:
+                        for w in workers:
+                            try:
+                                await w.close(report=False)
+                            except Exception:
+                                pass
+                        await s.close()
+
+            asyncio.run(run())
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__module__ = fn.__module__
+        return wrapper
+
+    return decorator
+
+
+class BlockedGatherDep(Worker):
+    """Sets ``in_gather_dep`` on first entering the gather path and then
+    holds the fetch until the test sets ``block_gather_dep`` — tasks stay
+    in flight indefinitely (reference utils_test.py:2202)."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self.in_gather_dep = asyncio.Event()
+        self.block_gather_dep = asyncio.Event()
+        super().__init__(*args, **kwargs)
+
+    async def _gather_dep(self, worker, to_gather, total_nbytes, stimulus_id):
+        self.in_gather_dep.set()
+        await self.block_gather_dep.wait()
+        return await super()._gather_dep(
+            worker, to_gather, total_nbytes, stimulus_id
+        )
+
+
+class BlockedGetData(Worker):
+    """Sets ``in_get_data`` when a peer asks for data and withholds the
+    answer until the test sets ``block_get_data`` (reference
+    utils_test.py:2238)."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self.in_get_data = asyncio.Event()
+        self.block_get_data = asyncio.Event()
+        super().__init__(*args, **kwargs)
+
+    async def get_data(self, keys=(), who=None, **kwargs):
+        self.in_get_data.set()
+        await self.block_get_data.wait()
+        return await super().get_data(keys=keys, who=who, **kwargs)
+
+
+class BlockedExecute(Worker):
+    """Sets ``in_execute`` on first entering execution and blocks until
+    the test sets ``block_execute``; then blocks once more between the
+    task body finishing and its completion event being processed
+    (``in_execute_exit`` / ``block_execute_exit``, reference
+    utils_test.py:2260)."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self.in_execute = asyncio.Event()
+        self.block_execute = asyncio.Event()
+        self.in_execute_exit = asyncio.Event()
+        self.block_execute_exit = asyncio.Event()
+        super().__init__(*args, **kwargs)
+
+    async def _execute(self, key, stimulus_id):
+        self.in_execute.set()
+        await self.block_execute.wait()
+        try:
+            return await super()._execute(key, stimulus_id)
+        finally:
+            self.in_execute_exit.set()
+            await self.block_execute_exit.wait()
+
+
+async def wait_for(predicate, timeout: float = 10, interval: float = 0.01):
+    """Poll ``predicate()`` until truthy (reference utils_test.py
+    async_poll_for)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("condition never became true")
+        await asyncio.sleep(interval)
+
+
+def inc(x):
+    return x + 1
+
+
+def add(x, y):
+    return x + y
+
+
+def slowinc(x, delay=0.1):
+    import time
+
+    time.sleep(delay)
+    return x + 1
