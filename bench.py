@@ -351,6 +351,8 @@ async def cfg_shuffle():
         columnar = False
 
     n_rows = 10_000_000 if columnar else 1_000_000
+    # 32 in-process workers saturate this host; BASELINE's 128 workers
+    # assume a real multi-host cluster
     n_parts = 64
     n_workers = 32
     rows_per = n_rows // n_parts
@@ -545,6 +547,15 @@ def run_config(name):
     print(json.dumps(result))
 
 
+def _parse_json_tail(stdout: str):
+    """Last JSON-looking line of a child's stdout, or None."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
 def probe_backend(env):
     """Probe jax backend init in a subprocess: hard timeout + retries."""
     last_err = None
@@ -598,12 +609,7 @@ def main():
             )
             if proc.stderr:
                 sys.stderr.write(proc.stderr[-2000:])
-            parsed = None
-            for line in reversed(proc.stdout.splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    parsed = json.loads(line)
-                    break
+            parsed = _parse_json_tail(proc.stdout)
             if parsed is None:
                 raise RuntimeError(
                     f"rc={proc.returncode}: "
@@ -626,12 +632,10 @@ def main():
                  "--config", "dag_1m"],
                 env=cpu_env, capture_output=True, text=True, timeout=600.0,
             )
-            for line in reversed(proc.stdout.splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    configs["dag_1m"] = json.loads(line)
-                    configs["dag_1m"]["backend"] = "cpu-fallback"
-                    break
+            parsed = _parse_json_tail(proc.stdout)
+            if parsed is not None:
+                parsed["backend"] = "cpu-fallback"
+                configs["dag_1m"] = parsed
             else:
                 errors["dag_1m_cpu_retry"] = (
                     f"rc={proc.returncode}: no JSON line in retry output: "

@@ -291,10 +291,11 @@ class Client:
                             # the batched stream and may postdate a
                             # RESUBMISSION of the key — only apply it to
                             # the FutureState the cancel targeted
-                            expected = self._cancel_expected.pop(key, None)
+                            missing = object()
+                            expected = self._cancel_expected.pop(key, missing)
                             st = self.futures.get(key)
                             if st is not None and (
-                                expected is None or st is expected
+                                expected is missing or st is expected
                             ):
                                 st.cancel()
                     elif op == "pubsub-msg":
@@ -355,6 +356,10 @@ class Client:
         if n <= 0:
             self.refcount.pop(key, None)
             self.futures.pop(key, None)
+            # a pending cancel-confirmation for a dead key will never
+            # matter again; don't let the sentinel (and its FutureState)
+            # outlive the futures entry
+            self._cancel_expected.pop(key, None)
             if self.status == "running" and not self.batched_stream.closed():
                 try:
                     self.batched_stream.send(
@@ -646,12 +651,14 @@ class Client:
         keys = [f.key for f in futures]
         # cancel synchronously client-side (reference client.py _cancel):
         # the scheduler's confirmation rides the batched stream and could
-        # otherwise cancel a future resubmitted in the meantime
+        # otherwise cancel a future resubmitted in the meantime.  A key
+        # with no state still registers (None) so the confirmation can
+        # never hit a later resubmission.
         for k in keys:
             st = self.futures.get(k)
             if st is not None:
                 st.cancel()
-                self._cancel_expected[k] = st
+            self._cancel_expected[k] = st
         assert self.scheduler is not None
         await self.scheduler.cancel(keys=keys, client=self.id, force=force)
 

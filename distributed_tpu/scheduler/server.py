@@ -811,18 +811,15 @@ class Scheduler(Server):
                               force: bool = False, **kwargs: Any) -> None:
         """Client cancels futures (reference scheduler.py:5161)."""
         stimulus_id = seq_name("cancel")
-        cancelled = []
-        for key in keys:
-            # report even for unknown keys: the client registered a
-            # _cancel_expected entry per requested key and consumes it on
-            # this confirmation
+        keys = list(keys)
+        if keys:
+            # one batched report, and for EVERY requested key (known or
+            # not): the client registered a _cancel_expected entry per
+            # key and consumes it on this confirmation
             self.report(
-                {"op": "cancelled-keys", "keys": [key]}, client=client
+                {"op": "cancelled-keys", "keys": keys}, client=client
             )
-            ts = self.state.tasks.get(key)
-            if ts is None:
-                continue
-            cancelled.append(key)
+        cancelled = [key for key in keys if key in self.state.tasks]
         client_msgs, worker_msgs = self.state.client_releases_keys(
             cancelled, client, stimulus_id
         )

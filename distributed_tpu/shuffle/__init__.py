@@ -1,4 +1,10 @@
-from distributed_tpu.shuffle.api import p2p_merge, p2p_rechunk, p2p_shuffle
+from distributed_tpu.shuffle.api import (
+    p2p_merge,
+    p2p_merge_arrays,
+    p2p_rechunk,
+    p2p_shuffle,
+    p2p_shuffle_arrays,
+)
 from distributed_tpu.shuffle.buffers import (
     CommShardsBuffer,
     DiskShardsBuffer,
@@ -14,8 +20,10 @@ from distributed_tpu.shuffle.scheduler_ext import ShuffleSchedulerExtension
 
 __all__ = [
     "p2p_shuffle",
+    "p2p_shuffle_arrays",
     "p2p_rechunk",
     "p2p_merge",
+    "p2p_merge_arrays",
     "ShuffleRun",
     "ShuffleSpec",
     "ShuffleWorkerExtension",

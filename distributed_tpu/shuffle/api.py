@@ -83,6 +83,33 @@ async def shuffle_unpack(shuffle_id: str, partition_id: int,
         await _restart_and_reschedule(worker, shuffle_id, run.run_id)
 
 
+# ------------------------------------------------------ columnar variants
+
+async def shuffle_transfer_arrays(data: Any, shuffle_id: str,
+                                  partition_id: int, on: str) -> int:
+    """Columnar transfer: one vectorized hash-split per input partition
+    (reference _shuffle.py:617 split_by_worker on arrow tables)."""
+    from distributed_tpu.shuffle.columnar import make_columnar_splitter
+
+    worker, run = await _run_for(shuffle_id)
+    try:
+        await run.add_partition(data, partition_id, make_columnar_splitter(on))
+    except ShuffleClosedError:
+        await _restart_and_reschedule(worker, shuffle_id, run.run_id)
+    return partition_id
+
+
+async def shuffle_unpack_arrays(shuffle_id: str, partition_id: int,
+                                barrier_result: int) -> Any:
+    from distributed_tpu.shuffle.columnar import concat_arrays
+
+    worker, run = await _run_for(shuffle_id)
+    try:
+        return await run.get_output_partition(partition_id, concat_arrays)
+    except ShuffleClosedError:
+        await _restart_and_reschedule(worker, shuffle_id, run.run_id)
+
+
 # ------------------------------------------------------- rechunk variants
 
 async def rechunk_transfer(chunk: Any, shuffle_id: str, partition_id: int,
@@ -273,6 +300,60 @@ async def p2p_shuffle(
         dict(g.tasks), unpack_keys, annotations_by_key=annotations,
     )
     return [futs[k] for k in unpack_keys]
+
+
+async def p2p_shuffle_arrays(
+    client: Any,
+    inputs: list,
+    npartitions_out: int | None = None,
+    on: str = "key",
+) -> list:
+    """Hash-shuffle COLUMNAR partitions ({column: ndarray} dicts) on the
+    ``on`` column; returns output futures of the same layout.  The
+    columnar analogue of the reference's arrow dataframe shuffle
+    (shuffle/_shuffle.py:617, _arrow.py): splitting and assembly are
+    vectorized numpy, ~100x the record-list path."""
+    npartitions_out = npartitions_out or len(inputs)
+    shuffle_id = f"shuffle-{uuid.uuid4().hex[:12]}"
+    worker_for = await _create_shuffle(
+        client, shuffle_id, npartitions_out, len(inputs)
+    )
+    g = Graph()
+    unpack_keys, annotations = _build_pipeline(
+        g, shuffle_id, inputs,
+        shuffle_transfer_arrays, lambda i: (i, on),
+        shuffle_unpack_arrays, (),
+        npartitions_out, worker_for,
+    )
+    futs = client._graph_to_futures(
+        dict(g.tasks), unpack_keys, annotations_by_key=annotations,
+    )
+    return [futs[k] for k in unpack_keys]
+
+
+def _join_parts(lp: Any, rp: Any, on: str = "key", how: str = "inner") -> Any:
+    from distributed_tpu.shuffle.columnar import join_arrays
+
+    return join_arrays(lp, rp, on, how)
+
+
+async def p2p_merge_arrays(
+    client: Any,
+    left: list,
+    right: list,
+    on: str = "key",
+    how: str = "inner",
+    npartitions_out: int | None = None,
+) -> list:
+    """Columnar P2P hash join: both sides are shuffled on ``on`` with the
+    SAME partition->worker assignment (the round-robin map is a pure
+    function of the sorted running workers), then joined partition-wise
+    with a local vectorized sort-merge join — the columnar analogue of
+    reference shuffle/_merge.py:434."""
+    npartitions_out = npartitions_out or max(len(left), len(right))
+    louts = await p2p_shuffle_arrays(client, left, npartitions_out, on=on)
+    routs = await p2p_shuffle_arrays(client, right, npartitions_out, on=on)
+    return client.map(_join_parts, louts, routs, on=on, how=how, pure=False)
 
 
 async def p2p_rechunk(client: Any, chunks: list, chunk_sizes: list[int],

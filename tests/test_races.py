@@ -305,28 +305,37 @@ async def test_repeated_worker_death_kills_task(c, s, a, b):
         return x
 
     fut = c.submit(forever, 1, key="kw-x")
-    for _ in range(3):
-        await wait_for(
-            lambda: (pts := s.state.tasks.get("kw-x")) is not None
-            and pts.processing_on is not None
-        )
-        addr = s.state.tasks["kw-x"].processing_on.address
-        victim = a if a.address == addr else b
-        await victim.close(report=False)
-        if s.state.tasks["kw-x"].state == "erred":
-            break
-        # revive a replacement so the cluster keeps going
-        from distributed_tpu.worker.server import Worker
+    extras = []  # replacement workers: the harness only closes originals
+    try:
+        for _ in range(3):
+            await wait_for(
+                lambda: (pts := s.state.tasks.get("kw-x")) is not None
+                and pts.processing_on is not None
+            )
+            addr = s.state.tasks["kw-x"].processing_on.address
+            victim = a if a.address == addr else b
+            await victim.close(report=False)
+            if s.state.tasks["kw-x"].state == "erred":
+                break
+            # revive a replacement so the cluster keeps going
+            from distributed_tpu.worker.server import Worker
 
-        nw = Worker(s.address, nthreads=1, validate=True,
-                    listen_addr="inproc://")
-        await nw.start()
-        if victim is a:
-            a = nw
-        else:
-            b = nw
-    with pytest.raises(KilledWorker):
-        await fut.result()
+            nw = Worker(s.address, nthreads=1, validate=True,
+                        listen_addr="inproc://")
+            await nw.start()
+            extras.append(nw)
+            if victim is a:
+                a = nw
+            else:
+                b = nw
+        with pytest.raises(KilledWorker):
+            await fut.result()
+    finally:
+        for nw in extras:
+            try:
+                await nw.close(report=False)
+            except Exception:
+                pass
 
 
 @gen_cluster(nthreads=[1, 1, 1])
