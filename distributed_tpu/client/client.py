@@ -162,8 +162,14 @@ class Client:
         name: str | None = None,
         timeout: float = 10.0,
         heartbeat_interval: float | None = None,
+        security: Any | None = None,
     ):
         self.address = address
+        self.security = security
+        self._connection_args = (
+            security.get_connection_args("client") if security is not None
+            else {}
+        )
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
         self.futures: dict[Key, FutureState] = {}
         self.refcount: dict[Key, int] = {}
@@ -205,7 +211,7 @@ class Client:
 
     async def _start(self) -> "Client":
         self.loop = asyncio.get_running_loop()
-        comm = await connect(self.address)
+        comm = await connect(self.address, **self._connection_args)
         await comm.write(
             {"op": "register-client", "client": self.id, "reply": False}
         )
@@ -214,7 +220,9 @@ class Client:
             raise ValueError(f"scheduler rejected client: {resp!r}")
         self.scheduler_comm = comm
         self.batched_stream.start(comm)
-        self.scheduler = rpc(self.address)
+        self.scheduler = rpc(
+            self.address, connection_args=self._connection_args
+        )
         self._handle_report_task = asyncio.create_task(self._handle_report())
         self.status = "running"
         logger.info("%s connected to %s", self.id, self.address)
@@ -548,7 +556,9 @@ class Client:
         if r is None:
             from distributed_tpu.rpc.core import rpc as _rpc
 
-            r = self._worker_rpcs[address] = _rpc(address)
+            r = self._worker_rpcs[address] = _rpc(
+                address, connection_args=self._connection_args
+            )
         return r
 
     async def gather(self, futures: Any, errors: str = "raise") -> Any:

@@ -143,10 +143,16 @@ def _sock_addrs(writer: asyncio.StreamWriter, scheme: str) -> tuple[str, str]:
 class TCPConnector(Connector):
     scheme = "tcp"
     ssl_context = None
+    use_ssl = False  # the SCHEME decides: tcp:// never handshakes TLS,
+    # even when connection_args carry an ssl_context (a secured client
+    # talking to a plain endpoint must not TLS a plaintext listener)
 
     async def connect(self, address: str, deserialize: bool = True, **kwargs: Any) -> Comm:
         host, port = parse_host_port(address)
-        ssl_ctx = kwargs.get("ssl_context", self.ssl_context)
+        ssl_ctx = (
+            kwargs.get("ssl_context", self.ssl_context)
+            if self.use_ssl else None
+        )
         try:
             reader, writer = await asyncio.open_connection(
                 host, port, ssl=ssl_ctx, limit=2**24
@@ -171,6 +177,7 @@ def ssl_error_types():
 
 class TLSConnector(TCPConnector):
     scheme = "tls"
+    use_ssl = True
 
     async def connect(self, address: str, deserialize: bool = True, **kwargs: Any) -> Comm:
         if kwargs.get("ssl_context") is None:
@@ -193,7 +200,11 @@ class TCPListener(Listener):
         self.handle_comm = handle_comm
         self.deserialize = deserialize
         self.server: asyncio.AbstractServer | None = None
-        self.ssl_context = kwargs.get("ssl_context")
+        # scheme decides: a tcp:// listener serves plaintext even when
+        # listen_args carry an ssl_context (the address must not lie)
+        self.ssl_context = (
+            kwargs.get("ssl_context") if self.scheme == "tls" else None
+        )
         self._comms: set[Comm] = set()
 
     async def _on_connection(self, reader: asyncio.StreamReader,

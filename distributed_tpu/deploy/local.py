@@ -28,20 +28,27 @@ class LocalCluster:
         threads_per_worker: int = 1,
         *,
         protocol: str = "inproc",
+        security: Any | None = None,
         scheduler_kwargs: dict | None = None,
         worker_kwargs: dict | None = None,
     ):
         self.n_workers = n_workers
         self.threads_per_worker = threads_per_worker
         self.protocol = protocol
+        self.security = security
         if protocol == "inproc":
             listen_addr = "inproc://"
         else:
             listen_addr = f"{protocol}://127.0.0.1:0"
+        scheduler_kwargs = dict(scheduler_kwargs or {})
+        if security is not None:
+            scheduler_kwargs.setdefault("security", security)
         self.scheduler = Scheduler(
-            listen_addr=listen_addr, **(scheduler_kwargs or {})
+            listen_addr=listen_addr, **scheduler_kwargs
         )
-        self._worker_kwargs = worker_kwargs or {}
+        self._worker_kwargs = dict(worker_kwargs or {})
+        if security is not None:
+            self._worker_kwargs.setdefault("security", security)
         self.workers: list[Worker] = []
         self._started = False
 
@@ -84,7 +91,7 @@ class LocalCluster:
                 await w.finished()
 
     def get_client(self) -> Client:
-        return Client(self.scheduler.address)
+        return Client(self.scheduler.address, security=self.security)
 
     async def close(self) -> None:
         for worker in self.workers:

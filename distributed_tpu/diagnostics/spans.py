@@ -47,7 +47,7 @@ class Span:
     """Aggregated stats for one span node (reference spans.py:74)."""
 
     __slots__ = ("id", "name", "parent", "children", "states", "n_tasks",
-                 "compute_seconds", "nbytes", "start", "stop")
+                 "compute_seconds", "nbytes", "start", "stop", "activity")
 
     def __init__(self, name: tuple[str, ...], parent: "Span | None" = None):
         self.id = f"span-{uuid.uuid4().hex[:12]}"
@@ -60,6 +60,9 @@ class Span:
         self.nbytes = 0
         self.start = 0.0
         self.stop = 0.0
+        # fine performance metrics: (prefix, label, unit) -> total
+        # (reference spans.py cumulative_worker_metrics)
+        self.activity: defaultdict[tuple[str, str, str], float] = defaultdict(float)
 
     def to_dict(self) -> dict:
         return {
@@ -71,6 +74,9 @@ class Span:
             "nbytes": self.nbytes,
             "start": self.start,
             "stop": self.stop,
+            "activity": {
+                "|".join(k): v for k, v in self.activity.items()
+            },
             "children": [c.to_dict() for c in self.children],
         }
 
@@ -82,18 +88,48 @@ class SpansSchedulerExtension:
     def __init__(self, scheduler: "Scheduler"):
         self.scheduler = scheduler
         self.spans: dict[tuple[str, ...], Span] = {}
+        self.by_id: dict[str, Span] = {}
         self.key_span: dict[str, Span] = {}
+        # fleet-wide fine metrics, spans or not:
+        # (context, span_id, prefix, label, unit) -> total
+        # (reference spans.py cumulative_worker_metrics)
+        self.cumulative_worker_metrics: defaultdict[tuple, float] = (
+            defaultdict(float)
+        )
         scheduler.state.plugins["spans"] = self
         scheduler.handlers["get_spans"] = self.get_spans
+        scheduler.handlers["get_fine_metrics"] = self.get_fine_metrics
 
     def _get_or_create(self, name: tuple[str, ...]) -> Span:
         sp = self.spans.get(name)
         if sp is None:
             parent = self._get_or_create(name[:-1]) if len(name) > 1 else None
             sp = self.spans[name] = Span(name, parent)
+            self.by_id[sp.id] = sp
             if parent is not None:
                 parent.children.append(sp)
         return sp
+
+    def collect_fine_metrics(self, rows: list) -> None:
+        """Fold one worker heartbeat's activity samples in
+        (reference spans.py SpansSchedulerExtension.heartbeat)."""
+        for row in rows:
+            try:
+                context, span_id, prefix, label, unit, value = row
+            except (TypeError, ValueError):
+                continue
+            self.cumulative_worker_metrics[
+                (context, span_id, prefix, label, unit)
+            ] += value
+            sp = self.by_id.get(span_id)
+            if sp is not None:
+                sp.activity[(prefix, label, unit)] += value
+
+    async def get_fine_metrics(self) -> dict:
+        return {
+            "|".join(str(p) for p in k): v
+            for k, v in self.cumulative_worker_metrics.items()
+        }
 
     def transition(self, key: str, start: str, finish: str, *args: Any,
                    **kwargs: Any) -> None:
@@ -110,6 +146,15 @@ class SpansSchedulerExtension:
             sp.n_tasks += 1
             if not sp.start:
                 sp.start = time()
+            # stamp the group so compute-task messages carry the span id
+            # to workers (fine-metric attribution).  Last association
+            # wins: consecutive spans sharing a key prefix (and thus a
+            # TaskGroup) each retarget the group at association time —
+            # concurrent overlap of two spans on one prefix can still
+            # misattribute, which the reference avoids only by splitting
+            # TaskGroups per span
+            if ts.group is not None:
+                ts.group.span_id = sp.id
         sp.states[finish] += 1
         if finish == "memory" and start == "processing":
             for ss in kwargs.get("startstops") or ():

@@ -1,0 +1,164 @@
+"""Dashboard-lite: JSON API routes + one self-contained live HTML page.
+
+The reference serves a full bokeh dashboard (~6.4k LoC,
+dashboard/components/scheduler.py) plus a JSON API
+(http/scheduler/api.py).  The TPU-native rebuild keeps the data surface
+— workers table, task-stream window, memory timeseries, spans, fine
+metrics — as plain JSON routes, and renders them with a single
+dependency-free HTML page (inline SVG + fetch polling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def json_api_routes(scheduler: Any) -> dict[str, Callable]:
+    """JSON API (reference http/scheduler/api.py, json.py)."""
+
+    def workers() -> list:
+        out = []
+        for ws in scheduler.state.workers.values():
+            m = ws.metrics or {}
+            out.append(
+                {
+                    "address": ws.address,
+                    "name": str(ws.name),
+                    "nthreads": ws.nthreads,
+                    "status": ws.status.name
+                    if hasattr(ws.status, "name") else str(ws.status),
+                    "processing": len(ws.processing),
+                    "stored": len(ws.has_what),
+                    "managed_bytes": ws.nbytes,
+                    "occupancy": round(ws.occupancy, 3),
+                    "memory_rss": (m.get("host") or {}).get("memory", 0),
+                    "executing": m.get("executing", 0),
+                    "last_seen": ws.last_seen,
+                }
+            )
+        return out
+
+    def tasks() -> dict:
+        by_state: dict[str, int] = {}
+        by_prefix: dict[str, int] = {}
+        for ts in scheduler.state.tasks.values():
+            by_state[ts.state] = by_state.get(ts.state, 0) + 1
+            if ts.prefix is not None:
+                by_prefix[ts.prefix.name] = by_prefix.get(ts.prefix.name, 0) + 1
+        return {
+            "total": len(scheduler.state.tasks),
+            "by_state": by_state,
+            "by_prefix": by_prefix,
+            "queued": len(scheduler.state.queued),
+            "unrunnable": len(scheduler.state.unrunnable),
+        }
+
+    def task_stream() -> list:
+        return scheduler.task_stream.collect(count=400)
+
+    def memory() -> dict:
+        sysmon = (
+            scheduler.monitor.range_query() if scheduler.monitor else {}
+        )
+        per_worker = {
+            ws.address: {
+                "managed": ws.nbytes,
+                "rss": (ws.metrics.get("host") or {}).get("memory", 0)
+                if ws.metrics else 0,
+                "spilled": ws.metrics.get("spilled_bytes", 0)
+                if ws.metrics else 0,
+            }
+            for ws in scheduler.state.workers.values()
+        }
+        return {"scheduler": sysmon, "workers": per_worker}
+
+    async def spans() -> list:
+        return await scheduler.spans.get_spans()
+
+    async def fine_metrics() -> dict:
+        return await scheduler.spans.get_fine_metrics()
+
+    return {
+        "/api/v1/workers": workers,
+        "/api/v1/tasks": tasks,
+        "/api/v1/task_stream": task_stream,
+        "/api/v1/memory": memory,
+        "/api/v1/spans": spans,
+        "/api/v1/fine_metrics": fine_metrics,
+        "/dashboard": lambda: (DASHBOARD_HTML, "text/html; charset=utf-8"),
+    }
+
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>distributed-tpu</title>
+<style>
+ body{font:13px system-ui,sans-serif;margin:0;background:#111;color:#ddd}
+ h1{font-size:16px;margin:8px 12px}.muted{color:#888}
+ section{margin:10px 12px;padding:10px;background:#1a1a1f;border-radius:8px}
+ table{border-collapse:collapse;width:100%}
+ th,td{text-align:left;padding:3px 8px;border-bottom:1px solid #2a2a31}
+ th{color:#9ab}.num{text-align:right;font-variant-numeric:tabular-nums}
+ svg{width:100%;background:#15151a;border-radius:6px}
+ .bar{fill:#4c8dd6}.bar.err{fill:#d64c4c}
+ .state{display:inline-block;margin-right:10px}
+ .dot{display:inline-block;width:9px;height:9px;border-radius:50%;margin-right:4px}
+</style></head><body>
+<h1>distributed-tpu <span class=muted id=meta></span></h1>
+<section><b>Tasks</b> <span id=states></span></section>
+<section><b>Task stream</b> (last 400 completions)
+  <svg id=stream height=170 viewBox="0 0 1000 170" preserveAspectRatio="none"></svg>
+</section>
+<section><b>Workers</b><div id=workers></div></section>
+<section><b>Memory</b><svg id=mem height=120 viewBox="0 0 1000 120"
+  preserveAspectRatio="none"></svg></section>
+<script>
+const colors={};let hue=0;
+function color(n){if(!(n in colors)){colors[n]=`hsl(${(hue=hue+67)%360} 60% 55%)`}return colors[n]}
+async function j(p){const r=await fetch(p);return r.json()}
+async function tick(){
+ try{
+  const [ws,ts,stream,mem]=await Promise.all([
+    j('/api/v1/workers'),j('/api/v1/tasks'),
+    j('/api/v1/task_stream'),j('/api/v1/memory')]);
+  document.getElementById('meta').textContent=
+    `${ws.length} workers · ${ts.total} tasks`;
+  document.getElementById('states').innerHTML=Object.entries(ts.by_state)
+    .map(([s,n])=>`<span class=state><span class=dot style="background:${color(s)}"></span>${s}: ${n}</span>`).join('');
+  // workers table
+  const rows=ws.map(w=>`<tr><td>${w.name}</td><td>${w.address}</td>
+    <td class=num>${w.nthreads}</td><td class=num>${w.processing}</td>
+    <td class=num>${w.stored}</td>
+    <td class=num>${(w.managed_bytes/1e6).toFixed(1)} MB</td>
+    <td class=num>${w.occupancy}</td><td>${w.status}</td></tr>`).join('');
+  document.getElementById('workers').innerHTML=
+    `<table><tr><th>name</th><th>address</th><th>threads</th><th>proc</th>
+     <th>stored</th><th>managed</th><th>occupancy</th><th>status</th></tr>${rows}</table>`;
+  // task stream: rows per worker, bars per compute startstop
+  const workersSeen=[...new Set(stream.map(r=>r.worker))];
+  let t0=Infinity,t1=-Infinity;
+  for(const r of stream)for(const ss of r.startstops||[]){
+    t0=Math.min(t0,ss.start);t1=Math.max(t1,ss.stop)}
+  const svg=document.getElementById('stream');const H=170;
+  const rh=Math.max(6,Math.min(22,H/Math.max(workersSeen.length,1)));
+  let bars='';
+  if(t1>t0){const sx=1000/(t1-t0);
+   for(const r of stream){const y=workersSeen.indexOf(r.worker)*rh;
+    for(const ss of r.startstops||[]){
+     const x=(ss.start-t0)*sx,w=Math.max(1,(ss.stop-ss.start)*sx);
+     bars+=`<rect x="${x}" y="${y+1}" width="${w}" height="${rh-2}"
+       fill="${r.error?'#d64c4c':color(r.name)}"><title>${r.key}</title></rect>`}}}
+  svg.innerHTML=bars;
+  // memory per worker
+  const names=Object.keys(mem.workers);const bw=1000/Math.max(names.length,1);
+  let mx=1;for(const n of names){mx=Math.max(mx,mem.workers[n].rss||mem.workers[n].managed)}
+  let mbars='';names.forEach((n,i)=>{const m=mem.workers[n];
+    const h1=110*(m.managed/mx),h2=110*((m.rss||0)/mx);
+    mbars+=`<rect x="${i*bw+2}" width="${bw*0.4}" y="${115-h1}" height="${h1}" fill="#4c8dd6"><title>${n} managed</title></rect>
+            <rect x="${i*bw+2+bw*0.45}" width="${bw*0.4}" y="${115-h2}" height="${h2}" fill="#8d6cd6"><title>${n} rss</title></rect>`});
+  document.getElementById('mem').innerHTML=mbars;
+ }catch(e){document.getElementById('meta').textContent='disconnected: '+e}
+ setTimeout(tick,1000);
+}
+tick();
+</script></body></html>
+"""

@@ -71,7 +71,12 @@ class HTTPServer:
                     result = handler()
                     if asyncio.iscoroutine(result):
                         result = await result
-                    if isinstance(result, (dict, list)):
+                    if isinstance(result, tuple) and len(result) == 2:
+                        # (body, content_type) for non-default types
+                        body, ctype = result
+                        if isinstance(body, str):
+                            body = body.encode()
+                    elif isinstance(result, (dict, list)):
                         body = json.dumps(result, default=str).encode()
                         ctype = "application/json"
                     elif isinstance(result, bytes):

@@ -63,12 +63,18 @@ class Scheduler(Server):
         worker_ttl: float | None = None,
         idle_timeout: float | None = None,
         http_port: int | None = 0,
+        security: Any | None = None,
         **server_kwargs: Any,
     ):
         self._http_port = http_port
         self.http_server = None
         self.monitor = None
         self._listen_addr = listen_addr
+        self.security = security
+        if security is not None:
+            server_kwargs.setdefault(
+                "connection_args", security.get_connection_args("scheduler")
+            )
         if placement is None and config.get("scheduler.jax.enabled"):
             from distributed_tpu.scheduler.jax_placement import JaxPlacement
 
@@ -169,6 +175,13 @@ class Scheduler(Server):
         self.state.events_subscriber_hook = self._fan_out_event
         self.worker_plugins: dict[str, Any] = {}  # shipped to joining workers
         self.handlers["get_task_stream"] = self.get_task_stream
+        from distributed_tpu.diagnostics.memory_sampler import (
+            memory_sample_handler,
+        )
+
+        self.handlers["memory_sample"] = (
+            lambda **kw: memory_sample_handler(self, **kw)
+        )
         self.handlers["get_profile"] = self.get_profile
         self.stream_handlers["subscribe-topic"] = self.subscribe_topic
         self.stream_handlers["unsubscribe-topic"] = self.unsubscribe_topic
@@ -181,7 +194,11 @@ class Scheduler(Server):
 
         native.prebuild_async()
         addr = self._listen_addr or "tcp://127.0.0.1:0"
-        await self.listen(addr)
+        listen_args = (
+            self.security.get_listen_args("scheduler")
+            if self.security is not None else {}
+        )
+        await self.listen(addr, **listen_args)
         # observability: SystemMonitor sampling + HTTP routes
         from distributed_tpu.diagnostics.system_monitor import SystemMonitor
         from distributed_tpu.http.server import HTTPServer, scheduler_metrics
@@ -191,6 +208,8 @@ class Scheduler(Server):
             self.monitor.update, 0.5
         )
         if self._http_port is not None:
+            from distributed_tpu.http.dashboard import json_api_routes
+
             self.http_server = HTTPServer(
                 {
                     "/health": lambda: "ok",
@@ -198,6 +217,7 @@ class Scheduler(Server):
                     "/metrics": lambda: scheduler_metrics(self),
                     "/json/counts.json": self._counts_json,
                     "/sysmon": lambda: self.monitor.range_query(),
+                    **json_api_routes(self),
                 },
                 port=self._http_port,
             )
@@ -386,7 +406,7 @@ class Scheduler(Server):
 
     async def heartbeat_worker(
         self, address: str = "", now: float = 0.0, metrics: dict | None = None,
-        **kwargs: Any,
+        fine_metrics: list | None = None, **kwargs: Any,
     ) -> dict:
         ws = self.state.workers.get(address)
         if ws is None:
@@ -395,6 +415,8 @@ class Scheduler(Server):
         ws.last_seen = time()
         if metrics:
             ws.metrics = metrics
+        if fine_metrics and self.spans is not None:
+            self.spans.collect_fine_metrics(fine_metrics)
         return {"status": "OK", "time": time(),
                 "heartbeat-interval": self.heartbeat_interval()}
 
@@ -470,8 +492,11 @@ class Scheduler(Server):
 
     async def handle_close_client(self, client: str = "", **kwargs: Any) -> None:
         bs = self.client_comms.get(client)
-        if bs is not None:
-            bs.send({"op": "stream-closed"})
+        if bs is not None and not bs.closed():
+            try:
+                bs.send({"op": "stream-closed"})
+            except CommClosedError:
+                pass  # the client hung up first — that's the point
 
     # ----------------------------------------------------------- graph intake
 

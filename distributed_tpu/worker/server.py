@@ -78,12 +78,18 @@ class Worker(Server):
         heartbeat_interval: float | None = None,
         listen_addr: str | None = None,
         http_port: int | None = 0,
+        security: Any | None = None,
         **server_kwargs: Any,
     ):
         self._http_port = http_port
         self.http_server = None
         self.monitor = None
         self.scheduler_addr = scheduler_addr
+        self.security = security
+        if security is not None:
+            server_kwargs.setdefault(
+                "connection_args", security.get_connection_args("worker")
+            )
         self.nthreads = nthreads or 1
         self.memory_limit = memory_limit
         self._listen_addr = listen_addr
@@ -126,6 +132,9 @@ class Worker(Server):
         self.plugins: dict[str, Any] = {}
         self._pubsub_subs: dict[str, list] = {}
         self._async_instructions: set[asyncio.Task] = set()
+        from distributed_tpu.worker.metrics import FineMetrics
+
+        self.fine_metrics = FineMetrics()
 
         handlers = {
             "get_data": self.get_data,
@@ -182,7 +191,11 @@ class Worker(Server):
         addr = self._listen_addr
         if addr is None:
             addr = "tcp://127.0.0.1:0"
-        await self.listen(addr)
+        listen_args = (
+            self.security.get_listen_args("worker")
+            if self.security is not None else {}
+        )
+        await self.listen(addr, **listen_args)
         self.state.address = self.address
         from distributed_tpu.diagnostics.system_monitor import SystemMonitor
         from distributed_tpu.http.server import HTTPServer, worker_metrics
@@ -217,7 +230,7 @@ class Worker(Server):
 
     async def _register_with_scheduler(self) -> None:
         """Handshake + dual stream with the scheduler (reference worker.py:1164)."""
-        comm = await connect(self.scheduler_addr)
+        comm = await connect(self.scheduler_addr, **self.connection_args)
         from distributed_tpu.versions import get_versions
 
         await comm.write(
@@ -253,17 +266,20 @@ class Worker(Server):
     async def heartbeat(self) -> None:
         if self.batched_stream.closed():
             return
+        delta = self.fine_metrics.take()
         try:
             resp = await self.rpc(self.scheduler_addr).heartbeat_worker(
                 address=self.address,
                 now=time(),
                 metrics=self.metrics(),
+                fine_metrics=self.fine_metrics.rows(delta),
             )
             if resp.get("status") == "missing":
                 # scheduler forgot us (e.g. after its restart): re-register
                 await self.close()
         except (CommClosedError, OSError):
-            pass
+            # don't lose the activity samples to a transient blip
+            self.fine_metrics.restore(delta)
 
     def metrics(self) -> dict:
         out = {
@@ -331,16 +347,18 @@ class Worker(Server):
         self, keys: tuple = (), who: str | None = None, **kwargs: Any
     ) -> dict:
         """Serve locally-held task data to a peer (reference worker.py:1722)."""
+        t0 = time()
         data = {}
         for k in keys:
             if k in self.data:
                 data[k] = Serialize(self.data[k])
-        return {
-            "status": "OK",
-            "data": data,
-            "nbytes": {k: self.state.tasks[k].nbytes if k in self.state.tasks
-                       else sizeof(self.data[k]) for k in data},
-        }
+        nbytes = {k: self.state.tasks[k].nbytes if k in self.state.tasks
+                  else sizeof(self.data[k]) for k in data}
+        self._fine_metric("get-data", None, "", "serve", "seconds", time() - t0)
+        self._fine_metric(
+            "get-data", None, "", "serve", "bytes", float(sum(nbytes.values()))
+        )
+        return {"status": "OK", "data": data, "nbytes": nbytes}
 
     async def gather(self, who_has: dict[Key, list[str]] | None = None) -> dict:
         """Pull keys from peers into local memory (reference worker.py:1274)."""
@@ -604,6 +622,14 @@ class Worker(Server):
 
     # ------------------------------------------------------------- execute
 
+    def _fine_metric(self, context: str, span_id: str | None, prefix: str,
+                     label: str, unit: str, value: float) -> None:
+        """File one activity sample: heartbeat delta + cumulative t-digest
+        (reference metrics.py ContextMeter -> Worker.digest_metric)."""
+        self.fine_metrics.add(context, span_id, prefix, label, unit, value)
+        if unit == "seconds":
+            self.digest_metric(f"{context}-{label}-seconds", value)
+
     async def _execute(self, key: Key, stimulus_id: str) -> StateMachineEvent | None:
         """Run one task (reference worker.py:2210)."""
         ts = self.state.tasks.get(key)
@@ -632,15 +658,30 @@ class Worker(Server):
                     finally:
                         reset_async_worker(token)
                 else:
+                    import contextvars
+
+                    from distributed_tpu.utils.misc import key_split
                     from distributed_tpu.worker.context import set_thread_worker
+                    from distributed_tpu.worker.metrics import context_meter
+
+                    def _user_metric(label, value, unit,
+                                     _sid=ts.span_id, _pre=key_split(key)):
+                        self._fine_metric(
+                            "execute", _sid, _pre, label, unit, value
+                        )
 
                     def _call(fn=fn, args=args, kwargs=kwargs):
                         set_thread_worker(self, key)
                         return fn(*args, **kwargs)
 
-                    value = await asyncio.get_running_loop().run_in_executor(
-                        self.executor, _call
-                    )
+                    # context_meter callbacks installed here flow into the
+                    # fine metrics; copy_context propagates them into the
+                    # executor thread so user task code can emit samples
+                    with context_meter.add_callback(_user_metric):
+                        ctx = contextvars.copy_context()
+                        value = await asyncio.get_running_loop().run_in_executor(
+                            self.executor, ctx.run, _call
+                        )
                 if ts.actor:
                     # keep the instance resident; the task's value is a
                     # placeholder resolved to an Actor proxy client-side
@@ -652,6 +693,12 @@ class Worker(Server):
                 value = unwrap(run_spec)  # literal data baked into the graph
             stop = time()
             self.digest_metric("compute-duration", stop - start)
+            from distributed_tpu.utils.misc import key_split
+
+            self._fine_metric(
+                "execute", ts.span_id, key_split(key), "compute",
+                "seconds", stop - start,
+            )
             return ExecuteSuccessEvent(
                 stimulus_id=stimulus_id,
                 key=key,
@@ -694,6 +741,7 @@ class Worker(Server):
         self, worker: str, to_gather: tuple, total_nbytes: int, stimulus_id: str
     ) -> StateMachineEvent:
         """Fetch a batch of keys from one peer (reference worker.py:2030)."""
+        t0 = time()
         try:
             resp = await self.rpc(worker).get_data(
                 keys=list(to_gather), who=self.address
@@ -715,11 +763,18 @@ class Worker(Server):
                 stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather)
             )
         data = {k: unwrap(v) for k, v in resp.get("data", {}).items()}
+        nbytes = sum(sizeof(v) for v in data.values())
+        self._fine_metric(
+            "gather-dep", None, "", "transfer", "seconds", time() - t0
+        )
+        self._fine_metric(
+            "gather-dep", None, "", "transfer", "bytes", float(nbytes)
+        )
         return GatherDepSuccessEvent(
             stimulus_id=stimulus_id,
             worker=worker,
             data=data,
-            total_nbytes=sum(sizeof(v) for v in data.values()),
+            total_nbytes=nbytes,
         )
 
     def __repr__(self) -> str:

@@ -127,3 +127,77 @@ async def test_events_and_subscription():
             events = await c.get_events("my-topic")
             assert len(events) == 1
             assert events[0][1] == {"x": 1}
+
+
+@gen_test(timeout=60)
+async def test_json_api_and_dashboard():
+    """Dashboard-lite JSON routes + the self-contained HTML page
+    (reference http/scheduler/api.py, dashboard/)."""
+    import json as _json
+    import urllib.request
+
+    async with await new_cluster(
+        n_workers=2, scheduler_kwargs={"http_port": 0}
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x * 2, range(20), pure=False)
+            await c.gather(futs)
+            for w in cluster.workers:
+                await w.heartbeat()
+            port = cluster.scheduler.http_server.port
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as r:
+                    return r.headers.get_content_type(), r.read()
+
+            loop = asyncio.get_running_loop()
+            ct, body = await loop.run_in_executor(None, get, "/api/v1/workers")
+            ws = _json.loads(body)
+            assert ct == "application/json" and len(ws) == 2
+            assert all("managed_bytes" in w and "occupancy" in w for w in ws)
+
+            _, body = await loop.run_in_executor(None, get, "/api/v1/tasks")
+            tasks = _json.loads(body)
+            assert tasks["by_state"].get("memory", 0) >= 20
+
+            _, body = await loop.run_in_executor(
+                None, get, "/api/v1/task_stream"
+            )
+            stream = _json.loads(body)
+            assert len(stream) >= 20
+            assert all("startstops" in r for r in stream)
+
+            _, body = await loop.run_in_executor(None, get, "/api/v1/memory")
+            mem = _json.loads(body)
+            assert len(mem["workers"]) == 2
+
+            ct, body = await loop.run_in_executor(None, get, "/dashboard")
+            assert ct == "text/html"
+            assert b"task_stream" in body and b"<svg" in body
+
+
+@gen_test(timeout=60)
+async def test_memory_sampler():
+    """MemorySampler context manager records a cluster memory timeseries
+    (reference diagnostics/memory_sampler.py:180)."""
+    import numpy as np
+
+    from distributed_tpu.diagnostics.memory_sampler import MemorySampler
+
+    def chunk(i):
+        return np.ones(1_000_000)  # 8 MB
+
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            ms = MemorySampler()
+            async with ms.sample("run", client=c, interval=0.05):
+                futs = c.map(chunk, range(4), pure=False)
+                await c.gather(futs)
+                await asyncio.sleep(0.3)
+            series = ms.to_list("run")
+            assert len(series) >= 3
+            assert ms.max("run") >= 4 * 8_000_000
+            # offsets monotonically increase
+            assert all(b[0] > a[0] for a, b in zip(series, series[1:]))

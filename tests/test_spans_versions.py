@@ -106,3 +106,77 @@ def test_workspace_purges_stale_dirs(tmp_path):
         f.write("999999999")
     WorkSpace(str(tmp_path))  # re-scan purges it
     assert not os.path.exists(d.path)
+
+
+@gen_test(timeout=60)
+async def test_fine_metrics_per_span_activity():
+    """ContextMeter-style activity metering: execute seconds are
+    aggregated per (span, prefix, activity) on the scheduler, and
+    transfer/serve activities are metered fleet-wide
+    (reference metrics.py:159,336)."""
+    import time as _time
+
+    def work(x):
+        _time.sleep(0.05)
+        return x + 1
+
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            with span("metered"):
+                futs = c.map(work, range(4), pure=False)
+                await c.gather(futs)
+            # force a cross-worker transfer (gather-dep + get-data)
+            w0, w1 = [w.address for w in cluster.workers]
+            a = c.submit(work, 10, workers=[w0], key="fm-a")
+            b = c.submit(lambda v: v, a, workers=[w1], key="fm-b")
+            await b.result()
+            # heartbeats ship the deltas
+            for w in cluster.workers:
+                await w.heartbeat()
+            fine = await c.scheduler.get_fine_metrics()
+            assert any(
+                k.startswith("execute|") and k.endswith("|compute|seconds")
+                and v > 0
+                for k, v in fine.items()
+            ), fine
+            assert any(
+                k.startswith("gather-dep|") and "transfer|seconds" in k
+                for k in fine
+            ), fine
+            assert any(
+                k.startswith("get-data|") and "serve|bytes" in k
+                for k in fine
+            ), fine
+            # span-attributed compute seconds
+            spans = await c.get_spans()
+            metered = next(s for s in spans if s["name"] == ["metered"])
+            acts = metered["activity"]
+            key = next(k for k in acts if k.endswith("compute|seconds"))
+            assert acts[key] >= 4 * 0.05 * 0.9, acts
+
+
+@gen_test(timeout=60)
+async def test_context_meter_user_samples():
+    """User task code can emit custom activity samples through
+    context_meter; they land in the scheduler's fine metrics
+    (reference metrics.py:159)."""
+    def task_with_meter(x):
+        import time as _time
+
+        from distributed_tpu.worker.metrics import context_meter
+
+        with context_meter.meter("custom-phase"):
+            _time.sleep(0.02)
+        context_meter.digest_metric("custom-bytes", 1234, "bytes")
+        return x
+
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.gather(c.map(task_with_meter, range(3), pure=False))
+            for w in cluster.workers:
+                await w.heartbeat()
+            fine = await c.scheduler.get_fine_metrics()
+            assert any("custom-phase|seconds" in k and v >= 0.02
+                       for k, v in fine.items()), fine
+            assert any("custom-bytes|bytes" in k and v == 3 * 1234
+                       for k, v in fine.items()), fine

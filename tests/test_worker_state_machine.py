@@ -393,6 +393,9 @@ def test_compute_cancel_recompute_before_first_tick():
             "B", (), {"send": staticmethod(lambda msg: None)}
         )()
         w.digest_metric = lambda name, value: None
+        from distributed_tpu.worker.metrics import FineMetrics
+
+        w.fine_metrics = FineMetrics()
 
         # 1. compute-task -> Execute instruction (coroutine created but
         #    not yet ticked)
