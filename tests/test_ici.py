@@ -1,0 +1,102 @@
+"""ICI-class device data plane (ops/ici.py): mesh all-to-all shuffle and
+ring exchange on the virtual 8-device CPU mesh.  The point under test:
+shard bytes move device-to-device inside one jitted program — no comm
+layer, no msgpack, no host round-trip (the role of reference
+comm/ucx.py:211)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_tpu.ops.ici import (
+    _mix32,
+    compact_shuffle_output,
+    make_mesh_1d,
+    ring_exchange,
+    shuffle_on_mesh,
+)
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+@needs_mesh
+def test_shuffle_on_mesh_routes_and_preserves_rows():
+    mesh = make_mesh_1d(8)
+    N = 8 * 64
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 30, N).astype(np.int32)
+    vals = rng.random((N, 4)).astype(np.float32)
+    ko, vo, counts, sent = shuffle_on_mesh(mesh, keys, vals)
+    parts = compact_shuffle_output(ko, vo, counts, 8)
+    assert sum(len(k) for k, _ in parts) == N
+    # routing: every row landed on hash(key) % 8
+    for d, (k, _) in enumerate(parts):
+        assert (np.asarray(_mix32(k.astype(np.int32))) % 8 == d).all()
+    # integrity: multiset of (key, value) preserved end-to-end
+    want = sorted(
+        map(tuple, np.column_stack([keys, vals[:, 0]]).tolist())
+    )
+    got = sorted(
+        map(tuple, np.column_stack([
+            np.concatenate([k for k, _ in parts]),
+            np.concatenate([v for _, v in parts])[:, 0],
+        ]).tolist())
+    )
+    assert got == want
+
+
+@needs_mesh
+def test_shuffle_on_mesh_overflow_detected_not_silent():
+    mesh = make_mesh_1d(8)
+    # all rows share one key -> one destination: tiny capacity overflows
+    keys = np.full(8 * 16, 7, np.int32)
+    vals = np.arange(8 * 16, dtype=np.float32)[:, None]
+    ko, vo, counts, sent = shuffle_on_mesh(mesh, keys, vals, capacity=4)
+    # TRUE counts on both ends: source and receiver each see values
+    # above capacity and know rows were truncated
+    assert np.asarray(sent).max() > 4
+    assert np.asarray(counts).max() > 4
+
+
+@needs_mesh
+def test_shuffle_on_mesh_stays_on_device():
+    """The exchange is one jitted program over jax arrays: inputs sharded
+    on the mesh produce outputs sharded on the mesh, with no host
+    serialization layer in between."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = make_mesh_1d(8)
+    N = 8 * 32
+    keys = jax.device_put(
+        np.arange(N, dtype=np.int32),
+        NamedSharding(mesh, PartitionSpec("shuffle")),
+    )
+    vals = jax.device_put(
+        np.ones((N, 2), np.float32),
+        NamedSharding(mesh, PartitionSpec("shuffle")),
+    )
+    ko, vo, counts, sent = shuffle_on_mesh(mesh, keys, vals)
+    # outputs live on the mesh, still sharded over the shuffle axis
+    assert ko.sharding.is_equivalent_to(
+        NamedSharding(mesh, PartitionSpec("shuffle")), ko.ndim
+    )
+    assert int(np.asarray(counts).sum()) == N
+
+
+@needs_mesh
+def test_ring_exchange():
+    mesh = make_mesh_1d(8)
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    y = np.asarray(ring_exchange(mesh, x))
+    for i in range(8):
+        assert (y[(i + 1) % 8] == x[i]).all()
+    # a full lap returns home
+    z = x
+    for _ in range(8):
+        z = np.asarray(ring_exchange(mesh, z))
+    assert (z == x).all()
