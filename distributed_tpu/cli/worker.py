@@ -20,11 +20,17 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("scheduler", help="scheduler address (tcp://host:port)")
     p.add_argument("--nthreads", type=int, default=1, help="threads per worker")
+    p.add_argument("--host", default=None,
+                   help="interface to bind (default: loopback); a name/IP "
+                        "reachable from other hosts, or 'auto' to bind the "
+                        "interface this host uses to reach the scheduler")
     p.add_argument("--nworkers", default="1",
                    help="number of worker processes ('auto' = cpu count)")
     p.add_argument("--name", default=None, help="worker name prefix")
     p.add_argument("--memory-limit", default="0",
-                   help="bytes of memory per worker before spilling")
+                   help="memory per worker before spilling: bytes ('4GiB'), "
+                        "fraction of host memory (0.5), or 'auto' "
+                        "(host/cgroup limit split across --nworkers)")
     p.add_argument("--resources", default=None,
                    help='JSON dict of abstract resources, e.g. \'{"GPU": 2}\'')
     p.add_argument("--nanny", action="store_true", default=False,
@@ -40,8 +46,8 @@ def make_parser() -> argparse.ArgumentParser:
 async def run(args: argparse.Namespace) -> int:
     import os
 
-    from distributed_tpu import config
     from distributed_tpu.preloading import process_preloads
+    from distributed_tpu.utils.system import parse_memory_limit
     from distributed_tpu.worker.nanny import Nanny
     from distributed_tpu.worker.server import Worker
 
@@ -49,7 +55,16 @@ async def run(args: argparse.Namespace) -> int:
         os.cpu_count() or 1 if args.nworkers == "auto" else int(args.nworkers)
     )
     resources = json.loads(args.resources) if args.resources else None
-    memory_limit = config.parse_bytes(args.memory_limit)
+    memory_limit = parse_memory_limit(args.memory_limit, nworkers)
+    host = args.host
+    if host == "auto":
+        # the interface this host routes to the scheduler through: works
+        # for ssh aliases / jump hosts where the ssh destination name is
+        # not resolvable on the worker machine itself
+        from distributed_tpu.utils.system import outbound_ip
+
+        host = outbound_ip(args.scheduler)
+    listen_addr = f"tcp://{host}:0" if host else None
 
     servers = []
     all_preloads = []
@@ -58,13 +73,18 @@ async def run(args: argparse.Namespace) -> int:
             f"{args.name}-{i}" if args.name and nworkers > 1
             else args.name or None
         )
+        worker_kwargs = {}
+        if resources:
+            worker_kwargs["resources"] = resources
+        if listen_addr:
+            worker_kwargs["listen_addr"] = listen_addr
         if args.nanny:
             server = Nanny(
                 args.scheduler,
                 nthreads=args.nthreads,
                 name=name,
                 memory_limit=memory_limit,
-                worker_kwargs={"resources": resources} if resources else {},
+                worker_kwargs=worker_kwargs,
             )
         else:
             server = Worker(
@@ -72,7 +92,7 @@ async def run(args: argparse.Namespace) -> int:
                 nthreads=args.nthreads,
                 name=name,
                 memory_limit=memory_limit,
-                resources=resources,
+                **worker_kwargs,
             )
         await server.start()
         # preloads run with the server live (dtpu_setup may read .address)

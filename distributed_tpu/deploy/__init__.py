@@ -1,0 +1,19 @@
+from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.deploy.spec import Adaptive, Cluster, SpecCluster
+from distributed_tpu.deploy.ssh import SSHCluster
+from distributed_tpu.deploy.subprocess import (
+    SubprocessCluster,
+    SubprocessScheduler,
+    SubprocessWorker,
+)
+
+__all__ = [
+    "Adaptive",
+    "Cluster",
+    "LocalCluster",
+    "SSHCluster",
+    "SpecCluster",
+    "SubprocessCluster",
+    "SubprocessWorker",
+    "SubprocessScheduler",
+]

@@ -196,6 +196,7 @@ class Adaptive:
         self.wait_count = wait_count
         self.target_duration = target_duration
         self._task: asyncio.Task | None = None
+        self._rpc: Any | None = None
         self._down_streak = 0
         self.log: list[tuple] = []
 
@@ -218,28 +219,34 @@ class Adaptive:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
+        if self._rpc is not None:
+            await self._rpc.close_rpc()
+            self._rpc = None
 
-    def target(self) -> int:
-        """Desired worker count (reference scheduler.py:8400 adaptive_target)."""
+    async def target(self) -> int:
+        """Desired worker count, from the scheduler's ``adaptive_target``
+        (reference adaptive.py:18 driving scheduler.py:8400 over RPC).
+
+        In-process schedulers (LocalCluster, SpecCluster) are asked
+        directly; process-backed ones (SubprocessCluster, SSHCluster)
+        over RPC."""
         assert self.cluster is not None and self.cluster.scheduler is not None
-        s = self.cluster.scheduler.state
-        occupancy = sum(ws.occupancy for ws in s.workers.values())
-        queued = len(s.queued) + len(s.unrunnable)
-        avg_nthreads = (
-            max(1, s.total_nthreads // max(1, len(s.workers)))
-            if s.workers
-            else 1
-        )
-        cpu = 0
-        if occupancy > 0 or queued:
-            # enough workers to drain current work in target_duration
-            import math
-
-            cpu = math.ceil(
-                (occupancy / self.target_duration + queued) / avg_nthreads
+        scheduler = self.cluster.scheduler
+        if hasattr(scheduler, "state"):
+            cpu = scheduler.adaptive_target(
+                target_duration=self.target_duration
             )
-        if s.unrunnable and not s.workers:
-            cpu = max(1, cpu)
+        else:
+            if self._rpc is None:
+                from distributed_tpu.rpc.core import rpc
+
+                # one cached connection for the cluster's lifetime: a
+                # fresh dial every interval would be a TCP (or full TLS)
+                # handshake per second of pure overhead
+                self._rpc = rpc(self.cluster.scheduler_address)
+            cpu = await self._rpc.adaptive_target(
+                target_duration=self.target_duration
+            )
         return int(min(max(cpu, self.minimum), self.maximum))
 
     async def _loop(self) -> None:
@@ -255,7 +262,7 @@ class Adaptive:
     async def adapt(self) -> None:
         assert self.cluster is not None
         n_now = len(getattr(self.cluster, "workers", {}))
-        n_want = self.target()
+        n_want = await self.target()
         if n_want > n_now:
             self._down_streak = 0
             self.log.append(("up", n_now, n_want))

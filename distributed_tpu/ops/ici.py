@@ -117,18 +117,29 @@ def shuffle_on_mesh(
 def compact_shuffle_output(keys_out, values_out, counts, n_dev: int):
     """Host-side helper: strip padding from the receive buffers; returns
     per-destination-device (keys, values) pairs (tests / host consumers;
-    on-device consumers use the counts as a mask directly)."""
+    on-device consumers use the counts as a mask directly).
+
+    Enforces the capacity contract: a true count above the buffer
+    capacity means that block was truncated on the wire — raises rather
+    than silently returning short partitions."""
     keys_out = np.asarray(keys_out)
     values_out = np.asarray(values_out)
     counts = np.asarray(counts).reshape(n_dev, n_dev)
     B = keys_out.shape[1]
+    if (counts > B).any():
+        over = np.argwhere(counts > B)[0]
+        raise ValueError(
+            f"shuffle block truncated: count {counts[tuple(over)]} > "
+            f"capacity {B} for (dst, src)={tuple(over)}; re-run "
+            f"shuffle_on_mesh with capacity >= {int(counts.max())}"
+        )
     keys_out = keys_out.reshape(n_dev, n_dev, B)
     values_out = values_out.reshape(n_dev, n_dev, B, *values_out.shape[2:])
     out = []
     for d in range(n_dev):
         kparts, vparts = [], []
         for src in range(n_dev):
-            c = min(int(counts[d, src]), B)  # true count may exceed B
+            c = int(counts[d, src])
             kparts.append(keys_out[d, src, :c])
             vparts.append(values_out[d, src, :c])
         out.append((np.concatenate(kparts), np.concatenate(vparts)))
@@ -160,4 +171,9 @@ def ring_exchange(mesh: Mesh, x: Any, axis: str = "shuffle", shift: int = 1):
 def make_mesh_1d(n: int | None = None, axis: str = "shuffle") -> Mesh:
     devs = jax.devices()
     n = n or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"requested a {n}-device mesh but only {len(devs)} devices "
+            f"are available"
+        )
     return Mesh(np.asarray(devs[:n]), (axis,))

@@ -125,6 +125,7 @@ class Scheduler(Server):
             "events": self.get_events_handler,
             "missing_workers": self.get_missing_workers,
             "retire_workers": self.retire_workers,
+            "adaptive_target": self.adaptive_target,
             "remove_worker": self.remove_worker_handler,
             "rebalance": self.rebalance,
             "register_scheduler_plugin": self.register_scheduler_plugin,
@@ -908,6 +909,29 @@ class Scheduler(Server):
             return {"status": "OK", "result": Serialize(result)}
         except Exception as e:
             return error_message(e)
+
+    def adaptive_target(self, target_duration: float = 5.0) -> int:
+        """Desired worker count to drain current load in ``target_duration``
+        seconds (reference scheduler.py:8400).  Served over RPC so
+        out-of-process clusters (Subprocess/SSH) can adapt."""
+        import math
+
+        s = self.state
+        occupancy = sum(ws.occupancy for ws in s.workers.values())
+        queued = len(s.queued) + len(s.unrunnable)
+        avg_nthreads = (
+            max(1, s.total_nthreads // max(1, len(s.workers)))
+            if s.workers
+            else 1
+        )
+        cpu = 0
+        if occupancy > 0 or queued:
+            cpu = math.ceil(
+                (occupancy / target_duration + queued) / avg_nthreads
+            )
+        if s.unrunnable and not s.workers:
+            cpu = max(1, cpu)
+        return cpu
 
     async def retire_workers(self, workers: list[str] | None = None,
                              n: int | None = None, **kwargs: Any) -> list[str]:

@@ -1,0 +1,144 @@
+"""Process-backed deploy layer: SubprocessCluster, SSHCluster, memory-limit
+detection (reference deploy/tests/test_subprocess.py, test_ssh.py,
+tests/test_system.py patterns)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import stat
+import sys
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.ssh import SSHCluster
+from distributed_tpu.deploy.subprocess import SubprocessCluster, child_env
+from distributed_tpu.utils.system import (
+    MEMORY_LIMIT,
+    memory_limit,
+    parse_memory_limit,
+)
+
+from conftest import gen_test
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# pickled BY VALUE (cloudpickle), so subprocess workers need not import
+# this test module; see also test_scheduler_opaque_specs for the
+# by-reference case (scheduler must never unpickle user code)
+_inc = lambda x: x + 1  # noqa: E731
+
+
+@pytest.mark.slow
+@gen_test(timeout=120)
+async def test_subprocess_cluster_roundtrip():
+    async with SubprocessCluster(n_workers=2, nthreads=1) as cluster:
+        assert cluster.scheduler_address.startswith("tcp://127.0.0.1:")
+        assert len(cluster.workers) == 2
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(_inc, range(8))
+            assert await c.gather(futs) == list(range(1, 9))
+
+
+@pytest.mark.slow
+@gen_test(timeout=180)
+async def test_subprocess_cluster_scales():
+    async with SubprocessCluster(n_workers=1, nthreads=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await cluster.scale(3)
+            assert len(cluster.workers) == 3
+            # all three processes execute work
+            futs = c.map(_inc, range(12))
+            assert await c.gather(futs) == list(range(1, 13))
+            await cluster.scale(1)
+            assert len(cluster.workers) == 1
+            # the survivor still works after its peers were retired
+            assert await c.submit(_inc, 100).result() == 101
+
+
+def _write_fake_ssh(tmp_path) -> str:
+    """An 'ssh client' that ignores the host and runs the command locally —
+    exercises SSHCluster's full command construction + address discovery."""
+    script = tmp_path / "fake-ssh"
+    script.write_text('#!/bin/bash\nshift\nexec bash -c "$*"\n')
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+@pytest.mark.slow
+@gen_test(timeout=120)
+async def test_ssh_cluster_roundtrip(tmp_path=None):
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as td:
+        fake_ssh = _write_fake_ssh(Path(td))
+        env = child_env()
+        async with SSHCluster(
+            ["127.0.0.1", "127.0.0.1", "127.0.0.1"],
+            connect_command=[fake_ssh],
+            remote_python=sys.executable,
+            env_vars={
+                "PYTHONPATH": env["PYTHONPATH"],
+                "JAX_PLATFORMS": "cpu",
+            },
+            scheduler_options={"port": 0},
+        ) as cluster:
+            # bind address rewritten to the dialable host
+            assert cluster.scheduler_address.startswith("tcp://127.0.0.1:")
+            assert len(cluster.workers) == 2
+            async with Client(cluster.scheduler_address) as c:
+                futs = c.map(_inc, range(6))
+                assert await c.gather(futs) == list(range(1, 7))
+
+
+def test_ssh_cluster_needs_two_hosts():
+    with pytest.raises(ValueError, match="hosts"):
+        SSHCluster(["onlyhost"])
+
+
+def test_ssh_command_construction():
+    from distributed_tpu.deploy.ssh import SSHScheduler, SSHWorker
+
+    s = SSHScheduler(
+        "gw", port=8786, connect_command=["ssh", "-A"],
+        remote_python="/opt/py/bin/python", env_vars={"X": "a b"},
+    )
+    argv = s._argv()
+    assert argv[:3] == ["ssh", "-A", "gw"]
+    assert "X='a b'" in argv[3]
+    assert "/opt/py/bin/python -m distributed_tpu.cli.scheduler" in argv[3]
+
+    w = SSHWorker("tcp://gw:8786", host="node1", nthreads=2, nanny=True)
+    argv = w._argv()
+    assert argv[:2] == ["ssh", "node1"]
+    assert "tcp://gw:8786" in argv[2]
+    assert "--nthreads 2" in argv[2]
+    assert "--nanny" in argv[2]
+    # binds the scheduler-routing interface, not the ssh alias
+    assert "--host auto" in argv[2]
+    w2 = SSHWorker("tcp://gw:8786", host="node1", bind_host="10.0.0.7")
+    assert "--host 10.0.0.7" in w2._argv()[2]
+
+
+def test_memory_limit_detection():
+    limit = memory_limit()
+    assert limit > 0
+    assert MEMORY_LIMIT == limit or MEMORY_LIMIT > 0
+    # never more than physical memory
+    import psutil
+
+    assert limit <= psutil.virtual_memory().total
+
+
+def test_parse_memory_limit():
+    assert parse_memory_limit(None) == 0
+    assert parse_memory_limit("0") == 0
+    assert parse_memory_limit(0) == 0
+    assert parse_memory_limit(12345) == 12345
+    assert parse_memory_limit("4GiB") == 4 * 2**30
+    assert parse_memory_limit("auto", nworkers=4) == MEMORY_LIMIT // 4
+    assert parse_memory_limit(0.5) == int(0.5 * MEMORY_LIMIT)
+    assert parse_memory_limit("0.5") == int(0.5 * MEMORY_LIMIT)

@@ -61,6 +61,13 @@ def test_shuffle_on_mesh_overflow_detected_not_silent():
     # above capacity and know rows were truncated
     assert np.asarray(sent).max() > 4
     assert np.asarray(counts).max() > 4
+    # the host-side compactor enforces the contract rather than
+    # silently returning short partitions
+    with pytest.raises(ValueError, match="truncated"):
+        compact_shuffle_output(ko, vo, counts, 8)
+    # mesh construction fails at the source when oversubscribed
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh_1d(1000)
 
 
 @needs_mesh
