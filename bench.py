@@ -529,8 +529,15 @@ def cfg_dag_1m():
 # harness
 # =====================================================================
 
-def run_config(name):
+def run_config(name, force_cpu=False):
     """Child entry: run one config, print its JSON dict as the last line."""
+    if force_cpu:
+        # JAX_PLATFORMS=cpu in the env is NOT enough on this box: a
+        # sitecustomize pins the axon (tunneled TPU) backend at import.
+        # jax.config.update works as long as no backend is initialized.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if name == "dag_1m":
         result = cfg_dag_1m()
     else:
@@ -598,10 +605,12 @@ def main():
     if probe_err:
         errors["backend_probe"] = probe_err
     for name, timeout, force_cpu in CONFIGS:
+        force_cpu = force_cpu or backend == "cpu-fallback"
         env = cpu_env if force_cpu else dict(os.environ)
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--config", name],
+                [sys.executable, os.path.abspath(__file__), "--config", name]
+                + (["--force-cpu"] if force_cpu else []),
                 env=env,
                 capture_output=True,
                 text=True,
@@ -629,7 +638,7 @@ def main():
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--config", "dag_1m"],
+                 "--config", "dag_1m", "--force-cpu"],
                 env=cpu_env, capture_output=True, text=True, timeout=600.0,
             )
             parsed = _parse_json_tail(proc.stdout)
@@ -662,7 +671,7 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--config":
-        run_config(sys.argv[2])
+        run_config(sys.argv[2], force_cpu="--force-cpu" in sys.argv)
     else:
         try:
             main()

@@ -25,6 +25,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="evict workers silent for this long")
     p.add_argument("--preload", action="append", default=[],
                    help="module to import (dtpu_setup hook) at startup")
+    p.add_argument("--jupyter", action="store_true", default=False,
+                   help="run a Jupyter server on the scheduler host, "
+                        "lifecycle-tied to the scheduler "
+                        "(reference scheduler.py:3663 --jupyter)")
+    p.add_argument("--jupyter-port", type=int, default=8888,
+                   help="port for the Jupyter server (with --jupyter)")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p
@@ -50,6 +56,10 @@ async def run(args: argparse.Namespace) -> int:
         await preload.start()
     print(f"Scheduler at: {scheduler.address}", flush=True)
 
+    jupyter_proc = None
+    if args.jupyter:
+        jupyter_proc = await _start_jupyter(args.host, args.jupyter_port)
+
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -59,9 +69,39 @@ async def run(args: argparse.Namespace) -> int:
     await asyncio.wait({finished, stopper}, return_when=asyncio.FIRST_COMPLETED)
     for preload in preloads:
         await preload.teardown()
+    if jupyter_proc is not None and jupyter_proc.returncode is None:
+        jupyter_proc.terminate()
+        try:
+            await asyncio.wait_for(jupyter_proc.wait(), 10)
+        except asyncio.TimeoutError:
+            jupyter_proc.kill()
     await scheduler.close()
     stopper.cancel()
     return 0
+
+
+async def _start_jupyter(host: str, port: int):
+    """Launch a Jupyter server next to the scheduler (the reference embeds
+    one in the scheduler's HTTP app, scheduler.py:3663-3690; here it is a
+    lifecycle-tied child process, same operator capability)."""
+    try:
+        import jupyter_server  # noqa: F401
+    except ImportError:
+        print("Jupyter not available: pip install jupyter-server", flush=True)
+        return None
+    import os
+
+    argv = [
+        sys.executable, "-m", "jupyter_server",
+        f"--ServerApp.ip={host}",
+        f"--ServerApp.port={port}",
+        "--ServerApp.open_browser=False",
+    ]
+    if hasattr(os, "geteuid") and os.geteuid() == 0:
+        argv.append("--allow-root")
+    proc = await asyncio.create_subprocess_exec(*argv)
+    print(f"Jupyter at: http://{host}:{port}/", flush=True)
+    return proc
 
 
 def main(argv: list[str] | None = None) -> int:

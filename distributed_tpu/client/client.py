@@ -20,10 +20,11 @@ import uuid
 from collections.abc import Iterable, Iterator
 from typing import Any, Callable
 
+from distributed_tpu import config
 from distributed_tpu.comm.core import Comm, connect
 from distributed_tpu.exceptions import CommClosedError
 from distributed_tpu.graph.spec import Graph, Key, TaskRef, TaskSpec, tokenize
-from distributed_tpu.protocol.serialize import Serialize, ToPickle, unwrap
+from distributed_tpu.protocol.serialize import Serialize, unwrap
 from distributed_tpu.rpc.batched import BatchedSend
 from distributed_tpu.rpc.core import raise_remote_error, rpc
 from distributed_tpu.utils.misc import LoopRunner, funcname, seq_name
@@ -384,6 +385,35 @@ class Client:
 
     # ------------------------------------------------------------ submission
 
+    _warned_large_fns: "set[int]" = set()
+
+    def _warn_large_function(self, fn: Callable) -> None:
+        """Task specs are serialized independently (one opaque leaf per
+        task — the scheduler never unpickles them), so a large captured
+        closure is pickled once PER TASK, not once per graph.  Warn like
+        the reference (client.py 'Large object of size ... detected')
+        and point at scatter, which exists for exactly this."""
+        fid = id(fn)
+        if fid in self._warned_large_fns:
+            return
+        try:
+            from distributed_tpu.protocol.pickle import dumps
+
+            nbytes = len(dumps(fn))
+        except Exception:
+            return
+        threshold = config.parse_bytes(
+            config.get("admin.large-function-warning-bytes")
+        )
+        if threshold and nbytes > threshold:
+            self._warned_large_fns.add(fid)
+            logger.warning(
+                "Large function payload (%.1f MiB) detected in map(): it is "
+                "serialized once per task. Move captured data into "
+                "arguments via client.scatter() and pass the future instead.",
+                nbytes / 2**20,
+            )
+
     def _graph_to_futures(
         self,
         tasks: dict[Key, Any],
@@ -430,7 +460,11 @@ class Client:
             {
                 "op": "update-graph",
                 "client": self.id,
-                "tasks": ToPickle(tasks),
+                # one Serialize leaf PER TASK, not one blob: the scheduler
+                # (deserialize=False) stores each run_spec as opaque frames
+                # and forwards them to workers verbatim — user code is
+                # unpickled only where it runs
+                "tasks": {k: Serialize(v) for k, v in tasks.items()},
                 "dependencies": deps,
                 "keys": list(keys),
                 "user_priority": priority,
@@ -496,6 +530,7 @@ class Client:
         """Map a function over argument lists (reference client.py:1967)."""
         iterables = tuple(list(it) for it in iterables)
         prefix = key or funcname(fn)
+        self._warn_large_function(fn)
         tasks: dict[Key, Any] = {}
         keys: list[Key] = []
         for i, zargs in enumerate(zip(*iterables)):

@@ -153,6 +153,9 @@ defaults: dict[str, Any] = {
     "dashboard": {"link": "{scheme}://{host}:{port}/status", "export-tool": False},
     "admin": {
         "large-graph-warning-threshold": "10MB",
+        # map() pickles the function once per task (specs are opaque
+        # per-task leaves): flag closures that make that expensive
+        "large-function-warning-bytes": "1MiB",
         "tick": {"interval": "20ms", "limit": "3s", "cycle": "1s"},
         "max-error-length": 10_000,
         "log-length": 10_000,

@@ -71,12 +71,17 @@ class SSHScheduler(SSHProcess):
         host: str,
         port: int = 0,
         bind_host: str = "0.0.0.0",
+        contact_host: str | None = None,
         extra_args: Sequence[str] = (),
         **ssh_kwargs: Any,
     ) -> None:
         super().__init__(host, **ssh_kwargs)
         self.port = port
         self.bind_host = bind_host
+        # the name workers dial: the ssh destination minus any user@
+        # prefix by default; pass contact_host when the ssh destination
+        # is a ~/.ssh/config alias other machines cannot resolve
+        self.contact_host = contact_host or host.rpartition("@")[2]
         self.extra_args = list(extra_args)
 
     def _remote_argv(self) -> list[str]:
@@ -94,7 +99,7 @@ class SSHScheduler(SSHProcess):
         assert self.address is not None
         proto, _, rest = self.address.partition("://")
         port = rest.rsplit(":", 1)[-1]
-        self.address = f"{proto}://{self.host}:{port}"
+        self.address = f"{proto}://{self.contact_host}:{port}"
         return self
 
     # SpecCluster._correct_state retires through the scheduler handle

@@ -55,16 +55,17 @@ class ProcessHandle:
 
     marker: str = ""
 
-    def __init__(self) -> None:
+    def __init__(self, extra_env: dict[str, str] | None = None) -> None:
         self.process: asyncio.subprocess.Process | None = None
         self.address: str | None = None
+        self.extra_env = dict(extra_env or {})
         self._drain_task: asyncio.Task | None = None
 
     def _argv(self) -> list[str]:  # pragma: no cover - interface
         raise NotImplementedError
 
     def _env(self) -> dict[str, str]:
-        return child_env()
+        return child_env(self.extra_env)
 
     async def start(self, timeout: float = _START_TIMEOUT) -> "ProcessHandle":
         self.process = await asyncio.create_subprocess_exec(
@@ -73,9 +74,15 @@ class ProcessHandle:
             stderr=asyncio.subprocess.STDOUT,
             env=self._env(),
         )
-        self.address = await asyncio.wait_for(
-            self._scan_for_marker(), timeout
-        )
+        try:
+            self.address = await asyncio.wait_for(
+                self._scan_for_marker(), timeout
+            )
+        except BaseException:
+            # a failed start must not orphan the child (it would hold its
+            # port forever); __aexit__ never runs for a failed __aenter__
+            await self.close()
+            raise
         self._drain_task = asyncio.create_task(self._drain())
         return self
 
@@ -150,8 +157,9 @@ class SubprocessScheduler(ProcessHandle):
         port: int = 0,
         protocol: str = "tcp",
         extra_args: Sequence[str] = (),
+        extra_env: dict[str, str] | None = None,
     ) -> None:
-        super().__init__()
+        super().__init__(extra_env)
         self.host = host
         self.port = port
         self.protocol = protocol
@@ -188,8 +196,9 @@ class SubprocessWorker(ProcessHandle):
         nanny: bool = False,
         memory_limit: str | int = "0",
         extra_args: Sequence[str] = (),
+        extra_env: dict[str, str] | None = None,
     ) -> None:
-        super().__init__()
+        super().__init__(extra_env)
         self.scheduler_address = scheduler_address
         self.name = name
         self.nthreads = nthreads

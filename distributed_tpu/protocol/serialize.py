@@ -100,6 +100,36 @@ class Pickled:
         self.frames = frames
 
 
+OPAQUE_TYPES = (Serialize, Serialized, ToPickle, Pickled)
+
+
+def wrap_opaque(obj: Any) -> Any:
+    """Prepare a possibly-already-wrapped payload for forwarding.
+
+    Opaque wrappers (how payloads look on a deserialize=False server)
+    pass through untouched — re-wrapping would deliver the wrapper
+    object itself to the peer.  Raw values are wrapped so they cross
+    tcp pickled.  None stays None."""
+    if obj is None or isinstance(obj, OPAQUE_TYPES):
+        return obj
+    return ToPickle(obj)
+
+
+def payload_nbytes(obj: Any) -> int:
+    """Size estimate for accounting without deserializing: frame bytes
+    for opaque payloads, sizeof for live objects."""
+    if isinstance(obj, (Serialized, Pickled)):
+        return sum(
+            len(f) if isinstance(f, (bytes, bytearray)) else f.nbytes
+            for f in obj.frames
+        )
+    if isinstance(obj, (Serialize, ToPickle)):
+        obj = obj.data
+    from distributed_tpu.utils.sizeof import sizeof
+
+    return sizeof(obj)
+
+
 def unwrap(obj: Any) -> Any:
     """Undo protocol wrappers that survive an in-process hop.
 
@@ -220,6 +250,10 @@ def serialize(x: Any, serializers: tuple[str, ...] | None = None) -> tuple[dict,
         return x.header, x.frames
     if isinstance(x, Serialize):
         x = x.data
+        if isinstance(x, Serialized):
+            # double-wrapped (e.g. a forwarding hop re-wrapped opaque
+            # frames): emit the frames, never pickle the wrapper object
+            return x.header, x.frames
     name = _family_for(x)
     if serializers is not None and name not in serializers:
         name = serializers[0]

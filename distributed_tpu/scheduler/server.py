@@ -17,7 +17,12 @@ from distributed_tpu import config
 from distributed_tpu.comm.core import Comm
 from distributed_tpu.exceptions import CommClosedError
 from distributed_tpu.graph.spec import Key
-from distributed_tpu.protocol.serialize import Serialize, unwrap
+from distributed_tpu.protocol.serialize import (
+    OPAQUE_TYPES,
+    Serialize,
+    unwrap,
+    wrap_opaque,
+)
 from distributed_tpu.rpc.batched import BatchedSend
 from distributed_tpu.rpc.core import (
     PeriodicCallback,
@@ -158,6 +163,14 @@ class Scheduler(Server):
             "heartbeat-client": self.handle_heartbeat_client,
             "close-client": self.handle_close_client,
         }
+        # deserialize=False: the scheduler NEVER unpickles user payloads
+        # (run_specs, scattered data, results, exceptions) — they pass
+        # through as opaque Serialized frames, so the scheduler process
+        # needs no user code and pays no pickle cost on the hot path
+        # (reference scheduler.py:3453 Server(deserialize=False)).
+        # Handlers that genuinely consume content (run_function, plugin
+        # registration) deserialize explicitly via unwrap().
+        server_kwargs.setdefault("deserialize", False)
         super().__init__(
             handlers=handlers, stream_handlers=stream_handlers, **server_kwargs
         )
@@ -295,10 +308,14 @@ class Scheduler(Server):
 
     @staticmethod
     def _wrap_payload(msg: dict) -> dict:
-        """Ensure non-msgpackable payloads cross the wire pickled."""
+        """Ensure non-msgpackable payloads cross the wire pickled.
+
+        Exceptions from workers are already opaque wrappers (this server
+        never deserialized them) and pass through; scheduler-raised ones
+        (KilledWorker, ...) are raw objects and get wrapped here."""
         for field in ("exception", "traceback"):
             v = msg.get(field)
-            if v is not None and not isinstance(v, (Serialize, str, bytes)):
+            if v is not None and not isinstance(v, (*OPAQUE_TYPES, str, bytes)):
                 msg = dict(msg)
                 msg[field] = Serialize(v)
         return msg
@@ -596,8 +613,11 @@ class Scheduler(Server):
             key,
             worker,
             stimulus_id or seq_name("task-erred"),
-            exception=unwrap(exception),
-            traceback=unwrap(traceback),
+            # opaque: user exceptions may be classes this process cannot
+            # import; they are stored and forwarded as-is, and the
+            # worker-supplied exception_text covers scheduler-side logs
+            exception=exception,
+            traceback=traceback,
             **kwargs,
         )
         self.send_all(client_msgs, worker_msgs)
@@ -733,7 +753,12 @@ class Scheduler(Server):
                 "keys": sorted(missing),
                 "workers": failed,
             }
-        return {"status": "OK", "data": {k: Serialize(v) for k, v in data.items()}}
+        return {
+            "status": "OK",
+            # worker payloads are already opaque frames on this server:
+            # forward without a deserialize/re-serialize round-trip
+            "data": {k: wrap_opaque(v) for k, v in data.items()},
+        }
 
     async def scatter(
         self,
@@ -745,7 +770,9 @@ class Scheduler(Server):
         **kwargs: Any,
     ) -> list[Key]:
         """Place client data onto workers (reference scheduler.py:6103)."""
-        data = {k: unwrap(v) for k, v in (unwrap(data) or {}).items()}
+        # values stay opaque: forwarded to workers as the frames the
+        # client sent; sizes come from the frames, not from unpickling
+        data = dict(unwrap(data) or {})
         start = time()
         while not self.state.running:
             if time() - start > timeout:
@@ -756,7 +783,7 @@ class Scheduler(Server):
         else:
             targets = sorted(ws.address for ws in self.state.running)
         who_has = await scatter_to_workers(targets, data, rpc=self.rpc)
-        from distributed_tpu.utils.sizeof import sizeof
+        from distributed_tpu.protocol.serialize import payload_nbytes
 
         stimulus_id = seq_name("scatter")
         for key, holders in who_has.items():
@@ -787,13 +814,13 @@ class Scheduler(Server):
                 # waiting dependents are recommended onward
                 recs, cmsgs, wmsgs = self.state._transition(
                     key, "memory", stimulus_id,
-                    worker=holders[0], nbytes=sizeof(data[key]),
+                    worker=holders[0], nbytes=payload_nbytes(data[key]),
                 )
                 cm2, wm2 = self.state.transitions(recs, stimulus_id)
                 self.send_all(_merge_msgs(cmsgs, cm2), _merge_msgs(wmsgs, wm2))
                 extra = holders[1:]
             else:
-                self.state.update_nbytes(ts, sizeof(data[key]))
+                self.state.update_nbytes(ts, payload_nbytes(data[key]))
                 extra = holders
             for addr in extra:
                 ws = self.state.workers.get(addr)
@@ -1215,7 +1242,7 @@ class Scheduler(Server):
         if ts is None:
             raise KeyError(key)
         return {
-            "run_spec": Serialize(ts.run_spec),
+            "run_spec": wrap_opaque(ts.run_spec),
             "deps": [d.key for d in ts.dependencies],
         }
 

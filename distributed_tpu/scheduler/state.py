@@ -43,6 +43,7 @@ from distributed_tpu.exceptions import (
     TransitionCounterMaxExceeded,
 )
 from distributed_tpu.graph.spec import TaskSpec
+from distributed_tpu.protocol.serialize import wrap_opaque
 from distributed_tpu.utils import HeapSet, key_split, time
 
 logger = logging.getLogger("distributed_tpu.scheduler")
@@ -1250,12 +1251,13 @@ class SchedulerState:
     def _task_to_msg(self, ts: TaskState, stimulus_id: str) -> dict:
         """Build the compute-task message (reference scheduler.py:3421).
 
-        ``run_spec`` is wrapped in ``ToPickle`` so it crosses tcp comms
-        pickled (the reference does the same, scheduler.py:3438); over
-        inproc the wrapper arrives intact and the worker unwraps it.
+        ``run_spec`` arrived from the client as an opaque wrapper
+        (``Serialize`` over inproc, ``Serialized`` frames over tcp —
+        the scheduler runs deserialize=False) and is forwarded to the
+        worker verbatim: no unpickle/repickle on the scheduler, and no
+        user code needed here (reference scheduler.py:3438).  Raw specs
+        (internal callers, tests) are wrapped so they cross tcp pickled.
         """
-        from distributed_tpu.protocol.serialize import ToPickle
-
         assert ts.priority is not None
         return {
             "op": "compute-task",
@@ -1266,7 +1268,7 @@ class SchedulerState:
                 dts.key: [wws.address for wws in dts.who_has] for dts in ts.dependencies
             },
             "nbytes": {dts.key: dts.nbytes for dts in ts.dependencies},
-            "run_spec": ToPickle(ts.run_spec) if ts.run_spec is not None else None,
+            "run_spec": wrap_opaque(ts.run_spec),
             "duration": self.get_task_duration(ts),
             "resource_restrictions": ts.resource_restrictions,
             "actor": ts.actor,

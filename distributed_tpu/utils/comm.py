@@ -88,13 +88,19 @@ async def scatter_to_workers(
     data: dict[str, Any],
     rpc: Callable,
 ) -> dict[str, list[str]]:
-    """Round-robin ``data`` onto ``workers``; returns ``{key: [worker]}``."""
-    from distributed_tpu.protocol.serialize import Serialize
+    """Round-robin ``data`` onto ``workers``; returns ``{key: [worker]}``.
+
+    Values arriving from a deserialize=False server (the scheduler) are
+    already opaque frames: wrap_opaque forwards them verbatim rather
+    than pickling the wrapper object a second time."""
+    from distributed_tpu.protocol.serialize import OPAQUE_TYPES, Serialize
 
     assert workers
     placements: dict[str, dict[str, Any]] = defaultdict(dict)
     for i, (key, value) in enumerate(data.items()):
-        placements[workers[i % len(workers)]][key] = Serialize(value)
+        if not isinstance(value, OPAQUE_TYPES):
+            value = Serialize(value)  # raw: family dispatch (numpy zero-copy)
+        placements[workers[i % len(workers)]][key] = value
 
     async def push(worker: str, chunk: dict):
         await rpc(worker).update_data(data=chunk, report=False)

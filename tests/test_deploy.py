@@ -127,6 +127,52 @@ def test_cli_scheduler_and_worker_roundtrip():
 
 
 @pytest.mark.slow
+def test_cli_scheduler_jupyter():
+    """--jupyter runs a lifecycle-tied Jupyter server next to the
+    scheduler (reference scheduler.py:3663 --jupyter flag)."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    pytest.importorskip("jupyter_server")
+    port = 18901
+
+    def up():
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/status", timeout=2
+            )
+            return True
+        except urllib.error.HTTPError:
+            return True  # 403 = alive, auth required
+        except Exception:
+            return False
+
+    sched = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--port", "0",
+         "--jupyter", "--jupyter-port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=CLI_ENV, cwd=REPO,
+    )
+    try:
+        line = sched.stdout.readline()
+        assert line.startswith("Scheduler at:"), line
+        assert sched.stdout.readline().startswith("Jupyter at:")
+        deadline = time.time() + 60
+        while time.time() < deadline and not up():
+            time.sleep(1)
+        assert up(), "jupyter server never came up"
+    finally:
+        sched.send_signal(signal.SIGTERM)
+        try:
+            sched.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            sched.kill()
+    time.sleep(1)
+    assert not up(), "jupyter survived scheduler shutdown"
+
+
+@pytest.mark.slow
 def test_cli_version():
     out = subprocess.run(
         [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--version"],

@@ -1,0 +1,115 @@
+"""The scheduler never touches user code or user payloads.
+
+Reference parity: the reference scheduler runs ``Server(deserialize=False)``
+so run_specs, results, and exceptions cross it as opaque frames and the
+scheduler process needs neither user modules nor pickle CPU on the hot
+path.  These tests pin that property structurally (wrapper types in
+scheduler state) and end-to-end (a scheduler that CANNOT import the user's
+module still schedules the work and routes the user-defined exception)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import textwrap
+
+import pytest
+
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.subprocess import SubprocessCluster
+from distributed_tpu.protocol.serialize import Serialize, Serialized
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
+
+from conftest import gen_test
+
+
+@gen_test()
+async def test_run_spec_stays_serialized_over_tcp():
+    """Over tcp the scheduler stores run_specs as Serialized frames —
+    never the live TaskSpec — and workers still execute them."""
+    async with Scheduler(listen_addr="tcp://127.0.0.1:0", validate=True) as s:
+        async with Worker(s.address, nthreads=1) as w:  # noqa: F841
+            async with Client(s.address) as c:
+                fut = c.submit(lambda x: x * 2, 21)
+                assert await fut.result() == 42
+                ts = s.state.tasks[fut.key]
+                assert isinstance(ts.run_spec, Serialized), type(ts.run_spec)
+
+
+@gen_test()
+async def test_run_spec_stays_wrapped_over_inproc():
+    """Over inproc nothing is serialized at all: the scheduler holds the
+    client's Serialize wrapper (zero-copy), opaque by convention."""
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=1) as w:  # noqa: F841
+            async with Client(s.address) as c:
+                fut = c.submit(lambda x: x + 1, 1)
+                assert await fut.result() == 2
+                ts = s.state.tasks[fut.key]
+                assert isinstance(ts.run_spec, Serialize), type(ts.run_spec)
+
+
+@gen_test()
+async def test_user_exception_stays_opaque_on_scheduler():
+    """A failing task's exception is held by the scheduler as opaque
+    frames (tcp) yet reaches the client as the real exception object."""
+    async with Scheduler(listen_addr="tcp://127.0.0.1:0", validate=True) as s:
+        async with Worker(s.address, nthreads=1):
+            async with Client(s.address) as c:
+                fut = c.submit(lambda: 1 / 0)
+                with pytest.raises(ZeroDivisionError):
+                    await fut.result()
+                ts = s.state.tasks[fut.key]
+                assert isinstance(ts.exception, Serialized), type(ts.exception)
+
+
+@pytest.mark.slow
+@gen_test(timeout=120)
+async def test_scheduler_schedules_code_it_cannot_import(tmp_path=None):
+    """End-to-end proof: client and workers share a user module; the
+    scheduler process does NOT have it on its path.  By-reference
+    pickles (function AND custom exception class) must flow client ->
+    scheduler -> worker -> scheduler -> client untouched."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        mod = os.path.join(td, "dtpu_userlib.py")
+        with open(mod, "w") as f:
+            f.write(textwrap.dedent("""
+                class UserError(Exception):
+                    pass
+
+                def triple(x):
+                    return x * 3
+
+                def boom():
+                    raise UserError("user-defined failure")
+                """))
+        sys.path.insert(0, td)
+        try:
+            import dtpu_userlib  # noqa: F401
+
+            worker_env = {"DTPU_USERLIB_DIR": td}
+            # workers get the module via PYTHONPATH; the scheduler's env
+            # is untouched (child_env gives it only the repo)
+            async with SubprocessCluster(
+                n_workers=1,
+                nthreads=1,
+                worker_options={
+                    "extra_env": {
+                        "PYTHONPATH": td + os.pathsep
+                        + os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+                    }
+                },
+            ) as cluster:
+                async with Client(cluster.scheduler_address) as c:
+                    fut = c.submit(dtpu_userlib.triple, 14)
+                    assert await asyncio.wait_for(fut.result(), 60) == 42
+                    bad = c.submit(dtpu_userlib.boom, pure=False)
+                    with pytest.raises(dtpu_userlib.UserError, match="user-defined"):
+                        await asyncio.wait_for(bad.result(), 60)
+        finally:
+            sys.path.remove(td)
+            sys.modules.pop("dtpu_userlib", None)
