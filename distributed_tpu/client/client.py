@@ -173,6 +173,11 @@ class Client:
         )
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
         self.futures: dict[Key, FutureState] = {}
+        # pickled-size cache for the large-closure warning: weak keys so
+        # user functions die normally and ids are never reused stale
+        import weakref
+
+        self._fn_sizes: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self.refcount: dict[Key, int] = {}
         self._cancel_expected: dict[Key, "FutureState"] = {}
         self.scheduler_comm: Comm | None = None
@@ -385,28 +390,30 @@ class Client:
 
     # ------------------------------------------------------------ submission
 
-    _warned_large_fns: "set[int]" = set()
-
     def _warn_large_function(self, fn: Callable) -> None:
         """Task specs are serialized independently (one opaque leaf per
         task — the scheduler never unpickles them), so a large captured
         closure is pickled once PER TASK, not once per graph.  Warn like
         the reference (client.py 'Large object of size ... detected')
         and point at scatter, which exists for exactly this."""
-        fid = id(fn)
-        if fid in self._warned_large_fns:
-            return
         try:
-            from distributed_tpu.protocol.pickle import dumps
+            nbytes = self._fn_sizes.get(fn)
+        except TypeError:
+            return  # unhashable/unweakrefable callable: skip the check
+        if nbytes is None:
+            try:
+                from distributed_tpu.protocol.pickle import dumps
 
-            nbytes = len(dumps(fn))
-        except Exception:
-            return
+                nbytes = len(dumps(fn))
+                self._fn_sizes[fn] = nbytes
+            except Exception:
+                return
+        else:
+            return  # measured before: already warned if it was large
         threshold = config.parse_bytes(
             config.get("admin.large-function-warning-bytes")
         )
         if threshold and nbytes > threshold:
-            self._warned_large_fns.add(fid)
             logger.warning(
                 "Large function payload (%.1f MiB) detected in map(): it is "
                 "serialized once per task. Move captured data into "

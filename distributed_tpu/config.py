@@ -52,6 +52,10 @@ defaults: dict[str, Any] = {
                                         # diverge from stealing/queuing
                                         # dynamics faster than they pay off
             "sync-plan": False,         # plan on-loop (deterministic tests)
+            # skip graph planning when mean transfer cost is below this
+            # fraction of mean task duration (locality can't pay there);
+            # 0 disables the gate
+            "min-transfer-ratio": 0.02,
             "capacity-doubling": True,  # grow SoA arrays by 2x
             "parity-check": False,      # run python oracle in lockstep (tests)
         },

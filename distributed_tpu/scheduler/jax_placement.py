@@ -64,7 +64,12 @@ class JaxPlacement:
     def __init__(self, min_batch: int | None = None,
                  max_batch: int | None = None,
                  min_workers: int | None = None,
-                 sync: bool | None = None):
+                 sync: bool | None = None,
+                 min_transfer_ratio: float | None = None):
+        self.min_transfer_ratio = (
+            min_transfer_ratio if min_transfer_ratio is not None
+            else float(config.get("scheduler.jax.min-transfer-ratio"))
+        )
         self.min_batch = (
             min_batch if min_batch is not None
             else config.get("scheduler.jax.min-batch")
@@ -125,6 +130,29 @@ class JaxPlacement:
             if dts is None or ws not in dts.who_has:
                 self.plan_misses += 1
                 return None
+        if state.idle and ws.address not in state.idle:
+            # The plan's wave model has drifted from live execution:
+            # capacity sits idle while the hint targets a busy worker.
+            # Blindly following it stacks queues that WorkStealing then
+            # drains AWAY from the data — plan and stealer fighting each
+            # other (measured: hints+stealing slower than either alone).
+            # Compare the oracle's objective (occupancy + transfer cost,
+            # reference scheduler.py:3131 worker_objective) for the hint
+            # vs an idle worker and yield when the hint is worse.
+            idle_ws = next(iter(state.idle.values()))
+            bw = state.bandwidth
+
+            def objective(w: "WorkerState") -> float:
+                missing = sum(
+                    dts.nbytes
+                    for dts in ts.dependencies
+                    if w not in dts.who_has and dts.nbytes > 0
+                )
+                return w.occupancy / max(w.nthreads, 1) + missing / bw
+
+            if objective(idle_ws) < objective(ws):
+                self.plan_misses += 1
+                return None
         self.plan_hits += 1
         return ws
 
@@ -165,7 +193,17 @@ class JaxPlacement:
         workers = [ws for ws in state.workers.values()]
         if len(workers) < max(self.min_workers, 2):
             return 0
-        snapshot = self._snapshot(state, batch, workers)
+        durations, out_bytes = self._snapshot_nodes(state, batch)
+        ratio = self.min_transfer_ratio
+        if ratio and float(out_bytes.mean()) / state.bandwidth < (
+            ratio * float(durations.mean())
+        ):
+            # transfers are noise next to compute: locality hints cannot
+            # pay for themselves on this graph (and occupancy-aware
+            # consumption would discard them anyway) — skip the dispatch
+            # before paying for the edge snapshot
+            return 0
+        snapshot = self._snapshot(state, batch, workers, durations, out_bytes)
 
         try:
             loop = asyncio.get_running_loop() if not self.sync else None
@@ -244,18 +282,14 @@ class JaxPlacement:
                     len(live), len(plan) - len(live),
                 )
 
-    def _snapshot(self, state: "SchedulerState", batch: list, workers: list):
-        """Synchronous SoA snapshot of the batch + worker fleet (the
-        TaskState graph must not be touched off-loop)."""
+    @staticmethod
+    def _snapshot_nodes(state: "SchedulerState", batch: list):
+        """Per-task cost arrays only — enough for the payoff gate."""
         import numpy as np
 
         n = len(batch)
-        index = {ts.key: i for i, ts in enumerate(batch)}
-        keys = [ts.key for ts in batch]
         durations = np.empty(n, np.float32)
         out_bytes = np.empty(n, np.float32)
-        src: list[int] = []
-        dst: list[int] = []
         for i, ts in enumerate(batch):
             durations[i] = state.get_task_duration(ts)
             nbytes = ts.nbytes
@@ -263,6 +297,19 @@ class JaxPlacement:
                 counts = sum(ts.prefix.state_counts.values()) or 1
                 nbytes = ts.prefix.nbytes_total / counts
             out_bytes[i] = nbytes if nbytes and nbytes > 0 else _DEFAULT_NBYTES
+        return durations, out_bytes
+
+    def _snapshot(self, state: "SchedulerState", batch: list, workers: list,
+                  durations, out_bytes):
+        """Synchronous SoA snapshot of the batch + worker fleet (the
+        TaskState graph must not be touched off-loop)."""
+        import numpy as np
+
+        index = {ts.key: i for i, ts in enumerate(batch)}
+        keys = [ts.key for ts in batch]
+        src: list[int] = []
+        dst: list[int] = []
+        for i, ts in enumerate(batch):
             for dts in ts.dependencies:
                 j = index.get(dts.key)
                 if j is not None:

@@ -135,7 +135,11 @@ def test_cli_scheduler_jupyter():
     import urllib.request
 
     pytest.importorskip("jupyter_server")
-    port = 18901
+    import socket
+
+    with socket.socket() as s:  # a free port, not a hardcoded one
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
 
     def up():
         try:

@@ -19,7 +19,7 @@ def inc(x):
 
 @gen_test(timeout=120)
 async def test_plan_consumed_and_results_correct():
-    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True)
+    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True, min_transfer_ratio=0)
     async with LocalCluster(
         n_workers=2,
         scheduler_kwargs={"validate": True, "placement": placement},
@@ -54,7 +54,7 @@ async def test_async_plan_lands_mid_execution():
     back to the python oracle with no loop stall."""
     import time as _time
 
-    placement = JaxPlacement(min_batch=4, min_workers=0)
+    placement = JaxPlacement(min_batch=4, min_workers=0, min_transfer_ratio=0)
     assert not placement.sync
 
     def slow_inc(x):
@@ -88,7 +88,7 @@ async def test_async_plan_lands_mid_execution():
 
 @gen_test(timeout=120)
 async def test_plan_fallback_when_worker_dies():
-    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True)
+    placement = JaxPlacement(min_batch=4, min_workers=0, sync=True, min_transfer_ratio=0)
     async with LocalCluster(
         n_workers=2,
         scheduler_kwargs={"validate": True, "placement": placement},
@@ -130,3 +130,46 @@ async def test_placement_disabled_by_flag():
         assert cluster.scheduler.state.placement is None
         async with Client(cluster.scheduler_address) as c:
             assert await c.submit(inc, 1).result() == 2
+
+
+def test_hint_yields_to_idle_worker_unless_locality_pays():
+    """Occupancy-aware hint consumption: when capacity sits idle and the
+    planned worker is busy, the hint holds only if the transfer cost it
+    avoids outweighs the wait (reference scheduler.py:3131
+    worker_objective semantics); otherwise it defers to the oracle so
+    the plan and WorkStealing never fight over the same queue."""
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    state = SchedulerState(validate=True)
+    busy = state.add_worker_state("tcp://h:1", nthreads=1, memory_limit=2**30)
+    idle = state.add_worker_state("tcp://h:2", nthreads=1, memory_limit=2**30)
+    state.check_idle_saturated(busy)
+    state.check_idle_saturated(idle)
+
+    dep = state.new_task("dep-1", None, "released")
+    dep.state = "memory"
+    dep.who_has.add(busy)
+    busy.has_what[dep] = None
+    ts = state.new_task("child-1", None, "released")
+    ts.add_dependency(dep)
+
+    placement = JaxPlacement(min_batch=1, min_workers=0, sync=True)
+
+    # busy worker has queued work; the other is idle
+    busy.occupancy = 10.0
+    state.idle.pop(busy.address, None)
+    assert idle.address in state.idle
+
+    # tiny dep: waiting behind 10s of queue to save a 1-byte transfer is
+    # absurd -> hint yields (miss), oracle will use the idle worker
+    dep.nbytes = 1
+    placement.plan = {ts.key: (busy.address, dep.key)}
+    assert placement.decide_worker(state, ts, None) is None
+    assert placement.plan_misses == 1
+
+    # huge dep (100s at the configured bandwidth): locality beats the
+    # 10s queue -> hint holds
+    dep.nbytes = int(state.bandwidth * 100)
+    placement.plan = {ts.key: (busy.address, dep.key)}
+    assert placement.decide_worker(state, ts, None) is busy
+    assert placement.plan_hits == 1

@@ -91,7 +91,6 @@ async def test_scheduler_schedules_code_it_cannot_import(tmp_path=None):
         try:
             import dtpu_userlib  # noqa: F401
 
-            worker_env = {"DTPU_USERLIB_DIR": td}
             # workers get the module via PYTHONPATH; the scheduler's env
             # is untouched (child_env gives it only the repo)
             async with SubprocessCluster(
