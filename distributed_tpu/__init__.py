@@ -62,4 +62,12 @@ def __getattr__(name: str):
         from distributed_tpu.diagnostics import plugin as _p
 
         return getattr(_p, name)
+    if name in ("SSHCluster", "SubprocessCluster"):
+        from distributed_tpu import deploy as _d
+
+        return getattr(_d, name)
+    if name in ("progress", "progress_sync"):
+        from distributed_tpu.diagnostics import progressbar as _pb
+
+        return getattr(_pb, name)
     raise AttributeError(f"module 'distributed_tpu' has no attribute {name!r}")

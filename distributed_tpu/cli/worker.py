@@ -38,6 +38,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-nanny", dest="nanny", action="store_false")
     p.add_argument("--preload", action="append", default=[],
                    help="module to import (dtpu_setup hook) at startup")
+    p.add_argument("--lifetime", default=None,
+                   help="retire the worker gracefully after this long "
+                        "(e.g. '1 hour'); for bounded-preemption hosts")
+    p.add_argument("--lifetime-stagger", default=None,
+                   help="uniform +/- jitter on --lifetime so a fleet "
+                        "doesn't cycle in lock-step (default: config)")
+    p.add_argument("--lifetime-restart", action="store_true", default=None,
+                   help="with --nanny: start a fresh worker after each "
+                        "lifetime instead of shutting down (default: config)")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p
@@ -54,8 +63,16 @@ async def run(args: argparse.Namespace) -> int:
     nworkers = (
         os.cpu_count() or 1 if args.nworkers == "auto" else int(args.nworkers)
     )
+    from distributed_tpu import config
+
     resources = json.loads(args.resources) if args.resources else None
     memory_limit = parse_memory_limit(args.memory_limit, nworkers)
+    # None = defer to the worker.lifetime.* config keys
+    lifetime = config.parse_timedelta(args.lifetime) if args.lifetime else None
+    lifetime_stagger = (
+        config.parse_timedelta(args.lifetime_stagger)
+        if args.lifetime_stagger is not None else None
+    )
     host = args.host
     if host == "auto":
         # the interface this host routes to the scheduler through: works
@@ -85,6 +102,9 @@ async def run(args: argparse.Namespace) -> int:
                 name=name,
                 memory_limit=memory_limit,
                 worker_kwargs=worker_kwargs,
+                lifetime=lifetime,
+                lifetime_stagger=lifetime_stagger,
+                lifetime_restart=args.lifetime_restart,
             )
         else:
             server = Worker(
@@ -92,6 +112,8 @@ async def run(args: argparse.Namespace) -> int:
                 nthreads=args.nthreads,
                 name=name,
                 memory_limit=memory_limit,
+                lifetime=lifetime,
+                lifetime_stagger=lifetime_stagger,
                 **worker_kwargs,
             )
         await server.start()

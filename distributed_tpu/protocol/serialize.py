@@ -213,6 +213,63 @@ def _jax_loads(header: dict, frames: list):
 register_serialization_family("jax", _jax_dumps, _jax_loads)
 
 
+def _torch_dumps(x) -> tuple[dict, list]:
+    """CPU torch tensors ride the numpy zero-copy path (reference
+    protocol/torch.py); non-contiguous or device tensors fall back to a
+    host-contiguous copy first."""
+    t = x.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    header, frames = _numpy_dumps(t.numpy())
+    header["serializer"] = "torch"
+    header["requires_grad"] = bool(x.requires_grad)
+    return header, frames
+
+
+def _torch_loads(header: dict, frames: list):
+    import torch
+
+    arr = _numpy_loads(header, frames)
+    t = torch.from_numpy(arr.copy())  # own the memory: frames may be mmapped
+    if header.get("requires_grad"):
+        t.requires_grad_(True)
+    return t
+
+
+register_serialization_family("torch", _torch_dumps, _torch_loads)
+
+
+def _arrow_dumps(x) -> tuple[dict, list]:
+    """Arrow Tables / RecordBatches via the IPC stream format (reference
+    protocol/arrow.py): schema-preserving, zero-copy on load."""
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    kind = "table" if isinstance(x, pa.Table) else "batch"
+    with pa.ipc.new_stream(sink, x.schema) as writer:
+        if kind == "table":
+            for batch in x.to_batches():
+                writer.write_batch(batch)
+        else:
+            writer.write_batch(x)
+    return {"serializer": "arrow", "kind": kind}, [sink.getvalue()]
+
+
+def _arrow_loads(header: dict, frames: list):
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.py_buffer(frames[0])) as reader:
+        table = reader.read_all()
+    if header.get("kind") == "batch":
+        return table.combine_chunks().to_batches()[0]
+    return table
+
+
+register_serialization_family("arrow", _arrow_dumps, _arrow_loads)
+
+
 def _error_dumps(x: Any) -> tuple[dict, list]:
     return {"serializer": "error"}, [repr(x).encode()[:10_000]]
 
@@ -240,6 +297,16 @@ def _family_for(x: Any) -> str:
                 return "jax"
         except ImportError:  # pragma: no cover
             pass
+    if mod.startswith("torch"):
+        import torch
+
+        if isinstance(x, torch.Tensor) and not x.is_sparse:
+            return "torch"
+    if mod.startswith("pyarrow"):
+        import pyarrow as pa
+
+        if isinstance(x, (pa.Table, pa.RecordBatch)):
+            return "arrow"
     return "pickle"
 
 

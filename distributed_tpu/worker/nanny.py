@@ -62,6 +62,9 @@ class Nanny(Server):
         worker_kwargs: dict | None = None,
         env: dict | None = None,
         listen_addr: str | None = None,
+        lifetime: float | None = None,
+        lifetime_stagger: float | None = None,
+        lifetime_restart: bool | None = None,
         **server_kwargs: Any,
     ):
         self.scheduler_addr = scheduler_addr
@@ -69,6 +72,20 @@ class Nanny(Server):
         self.worker_name = name
         self.memory_limit = memory_limit
         self.auto_restart = auto_restart
+        life_cfg = config.get("worker.lifetime") or {}
+        self.lifetime = (
+            lifetime if lifetime is not None
+            else config.parse_timedelta(life_cfg.get("duration"))
+        )
+        self.lifetime_stagger = (
+            lifetime_stagger if lifetime_stagger is not None
+            else config.parse_timedelta(life_cfg.get("stagger")) or 0
+        )
+        self.lifetime_restart = (
+            lifetime_restart if lifetime_restart is not None
+            else bool(life_cfg.get("restart"))
+        )
+        self._lifetime_task: Any | None = None
         self.env = dict(config.get("nanny.environ") or {})
         self.env.update(env or {})
         self.worker_kwargs = dict(worker_kwargs or {})
@@ -98,8 +115,55 @@ class Nanny(Server):
             from distributed_tpu.worker.memory import NannyMemoryManager
 
             self.memory_manager = NannyMemoryManager(self, self.memory_limit)
+        if self.lifetime:
+            self._lifetime_task = asyncio.create_task(self._lifetime_loop())
         self.start_periodic_callbacks()
         return self
+
+    async def _lifetime_loop(self) -> None:
+        """Bounded worker lifetime (reference dask-worker --lifetime):
+        after ``lifetime`` (± a uniform stagger so a fleet doesn't cycle
+        in lock-step), the worker is retired gracefully; with
+        ``lifetime_restart`` a fresh one is spawned, else the nanny shuts
+        down.  The tool for bounded-preemption environments."""
+        import random
+
+        while True:
+            delay = self.lifetime + random.uniform(
+                -self.lifetime_stagger, self.lifetime_stagger
+            )
+            await asyncio.sleep(max(delay, 0.1))
+            logger.info(
+                "worker %s reached its lifetime (%.0fs); %s",
+                self.worker_address, delay,
+                "restarting" if self.lifetime_restart else "retiring",
+            )
+            # disarm auto-restart FIRST: retire_workers terminates the
+            # worker over RPC, and an armed exit callback would race this
+            # loop to spawn a second (or zombie) worker
+            if self.process is not None:
+                self.process.set_exit_callback(lambda code: None)
+            try:
+                # retire first: the scheduler replicates unique data away
+                # and reschedules queued work before the process dies
+                if self.worker_address:
+                    await self.rpc(self.scheduler_addr).retire_workers(
+                        workers=[self.worker_address]
+                    )
+            except Exception:
+                logger.warning("lifetime retire failed", exc_info=True)
+            try:
+                await self.kill(graceful=True)
+            except Exception:
+                logger.exception("lifetime kill failed")
+            if not self.lifetime_restart:
+                self._ongoing_background_tasks.call_soon(self.close)
+                return
+            try:
+                await self.instantiate()
+            except Exception:
+                logger.exception("lifetime restart failed")
+                return
 
     async def instantiate(self, timeout: float = 60.0) -> str:
         """Spawn the worker subprocess, wait for its address
@@ -111,6 +175,9 @@ class Nanny(Server):
         kwargs.setdefault("nthreads", self.nthreads)
         kwargs.setdefault("name", self.worker_name)
         kwargs.setdefault("memory_limit", self.memory_limit)
+        # the NANNY owns the lifetime (it can restart); zero the child's
+        # own config-read timer or both would fire independently
+        kwargs.setdefault("lifetime", 0)
         env = dict(config.get("nanny.pre-spawn-environ") or {})
         env.update(self.env)
         self.process = AsyncProcess(
@@ -218,6 +285,9 @@ class Nanny(Server):
             return
         self.status = Status.closing
         logger.info("closing nanny %s", self.address)
+        if self._lifetime_task is not None:
+            self._lifetime_task.cancel()
+            self._lifetime_task = None
         await self.kill()
         await super().close()
 

@@ -79,6 +79,8 @@ class Worker(Server):
         listen_addr: str | None = None,
         http_port: int | None = 0,
         security: Any | None = None,
+        lifetime: float | None = None,
+        lifetime_stagger: float | None = None,
         **server_kwargs: Any,
     ):
         self._http_port = http_port
@@ -93,6 +95,16 @@ class Worker(Server):
         self.nthreads = nthreads or 1
         self.memory_limit = memory_limit
         self._listen_addr = listen_addr
+        life_cfg = config.get("worker.lifetime") or {}
+        self.lifetime = (
+            lifetime if lifetime is not None
+            else config.parse_timedelta(life_cfg.get("duration"))
+        )
+        self.lifetime_stagger = (
+            lifetime_stagger if lifetime_stagger is not None
+            else config.parse_timedelta(life_cfg.get("stagger")) or 0
+        )
+        self._lifetime_task: Any | None = None
         data = None
         if memory_limit:
             from distributed_tpu.utils.diskutils import WorkSpace
@@ -225,8 +237,33 @@ class Worker(Server):
         )
         if self.profiler is not None:
             self.profiler.start()
+        if self.lifetime:
+            self._lifetime_task = asyncio.create_task(self._lifetime_close())
         self.start_periodic_callbacks()
         return self
+
+    async def _lifetime_close(self) -> None:
+        """Standalone --lifetime: retire gracefully after the deadline
+        (reference worker.py lifetime / close_gracefully).  Under a Nanny
+        the NANNY owns the lifetime (it can also restart); this path is
+        for bare workers."""
+        import random
+
+        delay = self.lifetime + random.uniform(
+            -self.lifetime_stagger, self.lifetime_stagger
+        )
+        await asyncio.sleep(max(delay, 0.1))
+        logger.info(
+            "worker %s reached its lifetime (%.0fs); retiring", self.address,
+            delay,
+        )
+        try:
+            await self.rpc(self.scheduler_addr).retire_workers(
+                workers=[self.address]
+            )
+        except Exception:
+            logger.warning("lifetime retire failed", exc_info=True)
+        self._ongoing_background_tasks.call_soon(self.close)
 
     async def _register_with_scheduler(self) -> None:
         """Handshake + dual stream with the scheduler (reference worker.py:1164)."""
@@ -306,6 +343,9 @@ class Worker(Server):
             return
         self.status = Status.closing
         logger.info("closing worker %s", self.address)
+        if self._lifetime_task is not None:
+            self._lifetime_task.cancel()
+            self._lifetime_task = None
         for pc in self.periodic_callbacks.values():
             pc.stop()
         for plugin in list(self.plugins.values()):

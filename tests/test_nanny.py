@@ -67,3 +67,64 @@ async def test_nanny_graceful_kill_no_restart():
             await asyncio.sleep(0.5)
             # no auto-restart after an explicit kill
             assert nanny.process.pid == pid
+
+
+@gen_test(timeout=60)
+async def test_worker_lifetime_retires_gracefully():
+    """--lifetime on a bare worker: after the deadline it retires through
+    the scheduler (data replicated away) and closes; the cluster keeps
+    working (reference dask-worker --lifetime)."""
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.scheduler.server import Scheduler
+    from distributed_tpu.worker.server import Worker
+
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=1) as keeper:
+            mortal = Worker(s.address, nthreads=1, lifetime=0.8,
+                            lifetime_stagger=0)
+            await mortal.start()
+            try:
+                async with Client(s.address) as c:
+                    fut = c.submit(lambda: 123, workers=[mortal.address])
+                    assert await fut.result() == 123
+                    # wait out the lifetime: the mortal worker must leave
+                    for _ in range(200):
+                        if mortal.address not in s.state.workers:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert mortal.address not in s.state.workers
+                    assert keeper.address in s.state.workers
+                    # its data survived retirement and the cluster works
+                    assert await fut.result() == 123
+                    assert await c.submit(lambda: 7).result() == 7
+            finally:
+                await mortal.close()
+
+
+@pytest.mark.slow
+@gen_test(timeout=180)
+async def test_nanny_lifetime_restart_cycles_worker():
+    """--lifetime-restart under a nanny: each lifetime boundary retires
+    the worker process and spawns a fresh one (reference dask-worker
+    --lifetime-restart)."""
+    async with Scheduler(listen_addr="tcp://127.0.0.1:0", validate=True) as s:
+        nanny = Nanny(s.address, nthreads=1, lifetime=1.0,
+                      lifetime_stagger=0, lifetime_restart=True)
+        await nanny.start()
+        try:
+            first = nanny.worker_address
+            assert first is not None
+            for _ in range(600):
+                if (nanny.worker_address is not None
+                        and nanny.worker_address != first):
+                    break
+                await asyncio.sleep(0.2)
+            assert nanny.worker_address != first, "worker never cycled"
+            # the fresh worker registers with the scheduler
+            for _ in range(200):
+                if nanny.worker_address in s.state.workers:
+                    break
+                await asyncio.sleep(0.1)
+            assert nanny.worker_address in s.state.workers
+        finally:
+            await nanny.close()

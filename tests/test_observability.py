@@ -9,6 +9,8 @@ import time as _time
 
 from distributed_tpu.client.client import Client
 from distributed_tpu.deploy.local import LocalCluster
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
 
 from conftest import gen_test
 
@@ -201,3 +203,30 @@ async def test_memory_sampler():
             assert ms.max("run") >= 4 * 8_000_000
             # offsets monotonically increase
             assert all(b[0] > a[0] for a, b in zip(series, series[1:]))
+
+
+@gen_test()
+async def test_progress_bar_tracks_futures():
+    """progress() renders until every future settles and reports erred
+    counts (reference diagnostics/tests/test_progressbar.py)."""
+    import io
+
+    from distributed_tpu.diagnostics.progressbar import progress
+
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=2):
+            async with Client(s.address) as c:
+                futs = c.map(lambda x: x * 2, range(10))
+                buf = io.StringIO()
+                await asyncio.wait_for(progress(futs, file=buf), 30)
+                text = buf.getvalue()
+                assert "10/10" in text
+                assert text.endswith("\n")
+                assert await c.gather(futs) == [x * 2 for x in range(10)]
+
+                bad = c.map(
+                    lambda x: 1 // (x % 3), range(6), pure=False
+                )
+                buf = io.StringIO()
+                await asyncio.wait_for(progress(bad, file=buf), 30)
+                assert "2 erred" in buf.getvalue()
