@@ -47,6 +47,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--lifetime-restart", action="store_true", default=None,
                    help="with --nanny: start a fresh worker after each "
                         "lifetime instead of shutting down (default: config)")
+    p.add_argument("--no-lifetime-restart", dest="lifetime_restart",
+                   action="store_false",
+                   help="override a config-enabled lifetime restart")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p

@@ -1,0 +1,145 @@
+"""Pallas flash attention: the single-device hot op under long-context.
+
+Tiled online-softmax attention as a TPU kernel (`pl.pallas_call`): the
+grid runs (heads, q-tiles, k-tiles) with K/V streamed tile-by-tile
+through VMEM — neither the [N, N] score matrix nor the full K/V for a
+head ever resides on-chip, which is what makes truly long local blocks
+feasible (a 32k x 128 f32 K is 16 MiB — over VMEM — as one block, but
+trivial as 128-row tiles).  Running (m, l, acc) carries live in VMEM
+scratch across the innermost grid dimension; the normalized output is
+written on the last k-tile.  Matmuls hit the MXU as
+[block_q, D] x [D, block_k] products with f32 accumulation
+(guide: /opt/skills/guides/pallas_guide.md).
+
+Scope: this is the LOCAL kernel.  Cross-device sequence parallelism is
+``ops.ring_attention`` (mesh ring over ICI), whose per-block math is the
+same recurrence; on CPU test meshes the kernel runs in interpret mode.
+
+TPU shape notes: best with D a multiple of 128 and block sizes multiples
+of the (8, 128) f32 tile; sequence length must divide by the blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale, causal, block_q, block_k):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [TQ, D]
+    kblk = k_ref[0].astype(jnp.float32)  # [TK, D]
+    s = lax.dot_general(
+        q, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TQ, TK]
+    if causal:
+        iq = pl.program_id(1)
+        qpos = iq * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = ik * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    m_prev = m_scr[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_scr[:, 0] * alpha + p.sum(axis=-1)
+    acc_new = acc_scr[:] * alpha[:, None] + lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:, 0] = m_new
+    l_scr[:, 0] = l_new
+    acc_scr[:] = acc_new
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    h, n, d = q.shape
+    nk = k.shape[1]
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    # k-tiles innermost: the scratch carries survive across them and the
+    # output block (whose index map ignores ik) is revisited, written
+    # only on the final tile
+    return pl.pallas_call(
+        kernel,
+        grid=(h, n // block_q, nk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda ih, iq, ik: (ih, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda ih, iq, ik: (ih, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda ih, iq, ik: (ih, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda ih, iq, ik: (ih, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, n, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum-exp
+            pltpu.VMEM((block_q, d), jnp.float32),  # unnormalized acc
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = False, scale: float | None = None,
+    block_q: int = 128, block_k: int = 128, interpret: bool | None = None,
+):
+    """Flash attention over ``[seq, heads, dim]`` inputs on one device.
+
+    Blocks clamp to the sequence length; seq must divide by the (clamped)
+    blocks.  ``interpret`` defaults to True off-TPU so CPU test meshes
+    run the same kernel.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, nk = q.shape[0], k.shape[0]
+    block_q = min(block_q, n)
+    block_k = min(block_k, nk)
+    if n % block_q or nk % block_k:
+        raise ValueError(
+            f"seq lengths ({n}, {nk}) must divide by blocks "
+            f"({block_q}, {block_k})"
+        )
+    qt = jnp.transpose(q, (1, 0, 2))  # [H, N, D]
+    kt = jnp.transpose(k, (1, 0, 2))
+    vt = jnp.transpose(v, (1, 0, 2))
+    out = _flash_call(
+        qt, kt, vt, bool(causal), float(scale), block_q, block_k,
+        bool(interpret),
+    )
+    return jnp.transpose(out, (1, 0, 2))

@@ -254,7 +254,9 @@ def _arrow_dumps(x) -> tuple[dict, list]:
                 writer.write_batch(batch)
         else:
             writer.write_batch(x)
-    return {"serializer": "arrow", "kind": kind}, [sink.getvalue()]
+    # memoryview: the frame contract is bytes/memoryview (payload_nbytes,
+    # compression sampling), not pyarrow.Buffer
+    return {"serializer": "arrow", "kind": kind}, [memoryview(sink.getvalue())]
 
 
 def _arrow_loads(header: dict, frames: list):
@@ -263,7 +265,14 @@ def _arrow_loads(header: dict, frames: list):
     with pa.ipc.open_stream(pa.py_buffer(frames[0])) as reader:
         table = reader.read_all()
     if header.get("kind") == "batch":
-        return table.combine_chunks().to_batches()[0]
+        batches = table.combine_chunks().to_batches()
+        if batches:
+            return batches[0]
+        # zero-row tables yield no batches; rebuild an empty one
+        return pa.RecordBatch.from_arrays(
+            [pa.array([], type=f.type) for f in table.schema],
+            schema=table.schema,
+        )
     return table
 
 

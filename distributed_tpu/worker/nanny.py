@@ -72,18 +72,10 @@ class Nanny(Server):
         self.worker_name = name
         self.memory_limit = memory_limit
         self.auto_restart = auto_restart
-        life_cfg = config.get("worker.lifetime") or {}
-        self.lifetime = (
-            lifetime if lifetime is not None
-            else config.parse_timedelta(life_cfg.get("duration"))
-        )
-        self.lifetime_stagger = (
-            lifetime_stagger if lifetime_stagger is not None
-            else config.parse_timedelta(life_cfg.get("stagger")) or 0
-        )
-        self.lifetime_restart = (
-            lifetime_restart if lifetime_restart is not None
-            else bool(life_cfg.get("restart"))
+        from distributed_tpu.worker import resolve_lifetime
+
+        self.lifetime, self.lifetime_stagger, self.lifetime_restart = (
+            resolve_lifetime(lifetime, lifetime_stagger, lifetime_restart)
         )
         self._lifetime_task: Any | None = None
         self.env = dict(config.get("nanny.environ") or {})
@@ -126,13 +118,11 @@ class Nanny(Server):
         in lock-step), the worker is retired gracefully; with
         ``lifetime_restart`` a fresh one is spawned, else the nanny shuts
         down.  The tool for bounded-preemption environments."""
-        import random
+        from distributed_tpu.worker import sample_lifetime_delay
 
         while True:
-            delay = self.lifetime + random.uniform(
-                -self.lifetime_stagger, self.lifetime_stagger
-            )
-            await asyncio.sleep(max(delay, 0.1))
+            delay = sample_lifetime_delay(self.lifetime, self.lifetime_stagger)
+            await asyncio.sleep(delay)
             logger.info(
                 "worker %s reached its lifetime (%.0fs); %s",
                 self.worker_address, delay,
@@ -159,10 +149,22 @@ class Nanny(Server):
             if not self.lifetime_restart:
                 self._ongoing_background_tasks.call_soon(self.close)
                 return
-            try:
-                await self.instantiate()
-            except Exception:
-                logger.exception("lifetime restart failed")
+            # bounded retry with backoff, like the crash-restart path —
+            # a single transient spawn failure must not leave a zombie
+            # nanny supervising nothing
+            for attempt in range(1, self.MAX_RESTART_ATTEMPTS + 1):
+                try:
+                    await self.instantiate()
+                    break
+                except Exception:
+                    logger.exception(
+                        "lifetime restart failed (attempt %d/%d)",
+                        attempt, self.MAX_RESTART_ATTEMPTS,
+                    )
+                    await asyncio.sleep(0.5 * attempt)
+            else:
+                self.status = Status.failed
+                self._ongoing_background_tasks.call_soon(self.close)
                 return
 
     async def instantiate(self, timeout: float = 60.0) -> str:

@@ -95,14 +95,10 @@ class Worker(Server):
         self.nthreads = nthreads or 1
         self.memory_limit = memory_limit
         self._listen_addr = listen_addr
-        life_cfg = config.get("worker.lifetime") or {}
-        self.lifetime = (
-            lifetime if lifetime is not None
-            else config.parse_timedelta(life_cfg.get("duration"))
-        )
-        self.lifetime_stagger = (
-            lifetime_stagger if lifetime_stagger is not None
-            else config.parse_timedelta(life_cfg.get("stagger")) or 0
+        from distributed_tpu.worker import resolve_lifetime
+
+        self.lifetime, self.lifetime_stagger, _ = resolve_lifetime(
+            lifetime, lifetime_stagger
         )
         self._lifetime_task: Any | None = None
         data = None
@@ -247,12 +243,10 @@ class Worker(Server):
         (reference worker.py lifetime / close_gracefully).  Under a Nanny
         the NANNY owns the lifetime (it can also restart); this path is
         for bare workers."""
-        import random
+        from distributed_tpu.worker import sample_lifetime_delay
 
-        delay = self.lifetime + random.uniform(
-            -self.lifetime_stagger, self.lifetime_stagger
-        )
-        await asyncio.sleep(max(delay, 0.1))
+        delay = sample_lifetime_delay(self.lifetime, self.lifetime_stagger)
+        await asyncio.sleep(delay)
         logger.info(
             "worker %s reached its lifetime (%.0fs); retiring", self.address,
             delay,

@@ -154,3 +154,20 @@ def test_error_family_raises_on_load():
     frames = dumps({"x": Serialize(Unpicklable())})
     with pytest.raises(TypeError, match="Could not deserialize"):
         loads(frames)
+
+
+def test_arrow_empty_batch_and_frame_contract():
+    pa = pytest.importorskip("pyarrow")
+
+    # zero-row RecordBatch survives the roundtrip
+    empty = pa.RecordBatch.from_arrays(
+        [pa.array([], type=pa.int64()), pa.array([], type=pa.string())],
+        names=["k", "v"],
+    )
+    out = roundtrip({"data": Serialize(empty)})["data"]
+    assert isinstance(out, pa.RecordBatch)
+    assert out.num_rows == 0 and out.schema.equals(empty.schema)
+    # frames honor the bytes/memoryview contract (payload_nbytes sizes them)
+    header, frames = serialize(pa.table({"k": [1, 2, 3]}))
+    assert all(isinstance(f, (bytes, bytearray, memoryview)) for f in frames)
+    assert payload_nbytes(Serialized(header, frames)) > 0
