@@ -43,33 +43,42 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [TQ, D]
-    kblk = k_ref[0].astype(jnp.float32)  # [TK, D]
-    s = lax.dot_general(
-        q, kblk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [TQ, TK]
-    if causal:
-        iq = pl.program_id(1)
-        qpos = iq * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        kpos = ik * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(qpos >= kpos, s, _NEG)
-    m_prev = m_scr[:, 0]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    l_new = l_scr[:, 0] * alpha + p.sum(axis=-1)
-    acc_new = acc_scr[:] * alpha[:, None] + lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    iq = pl.program_id(1)
+    # causal: a k-tile strictly above the diagonal band is fully masked
+    # — skip its matmuls and VMEM traffic entirely (~2x on long causal
+    # sequences); the scratch carries pass through untouched
+    live = (
+        ik * block_k < (iq + 1) * block_q if causal else ik >= 0
     )
-    m_scr[:, 0] = m_new
-    l_scr[:, 0] = l_new
-    acc_scr[:] = acc_new
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [TQ, D]
+        kblk = k_ref[0].astype(jnp.float32)  # [TK, D]
+        s = lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [TQ, TK]
+        if causal:
+            qpos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_scr[:, 0] * alpha + p.sum(axis=-1)
+        acc_new = acc_scr[:] * alpha[:, None] + lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
+        acc_scr[:] = acc_new
 
     @pl.when(ik == nk - 1)
     def _finish():

@@ -1117,37 +1117,28 @@ class Scheduler(Server):
         if len(wss) < 2:
             return {"status": "OK", "moves": 0}
         keyset = set(keys) if keys is not None else None
-        mean = sum(ws.nbytes for ws in wss) / len(wss)
-        senders = sorted(
-            (ws for ws in wss if ws.nbytes > mean * 1.05),
-            key=lambda ws: -ws.nbytes,
+
+        from distributed_tpu.scheduler.jax_placement import (
+            device_dispatch_worthwhile,
         )
-        recipients = sorted(
-            (ws for ws in wss if ws.nbytes < mean * 0.95),
-            key=lambda ws: ws.nbytes,
-        )
-        moves: list[tuple] = []  # (ts, sender, recipient)
-        projected = {ws: ws.nbytes for ws in wss}
-        for sender in senders:
-            for ts in sorted(sender.has_what, key=lambda t: -t.get_nbytes()):
-                if projected[sender] <= mean:
-                    break
-                if keyset is not None and ts.key not in keyset:
-                    continue
+
+        # gate on MOVABLE candidates, not raw key count (a keys=[...]
+        # call or replicated data would otherwise dispatch the kernel
+        # for a handful of items); the filter is O(keys) either way
+        cand: list = []
+        owner: list[int] = []
+        for wi, ws in enumerate(wss):
+            for ts in ws.has_what:
                 if ts.actor or len(ts.who_has) != 1 or ts.state != "memory":
                     continue
-                if not recipients:
-                    break
-                recipient = recipients[0]
-                if projected[recipient] + ts.get_nbytes() > mean:
-                    recipients.sort(key=lambda ws: projected[ws])
-                    recipient = recipients[0]
-                    if projected[recipient] + ts.get_nbytes() > mean * 1.05:
-                        continue
-                moves.append((ts, sender, recipient))
-                projected[sender] -= ts.get_nbytes()
-                projected[recipient] += ts.get_nbytes()
-                recipients.sort(key=lambda ws: projected[ws])
+                if keyset is not None and ts.key not in keyset:
+                    continue
+                cand.append(ts)
+                owner.append(wi)
+        if device_dispatch_worthwhile(len(wss), len(cand), min_items=512):
+            moves = self._rebalance_plan_device(wss, cand, owner)
+        else:
+            moves = self._rebalance_plan_python(wss, keyset)
 
         # enact concurrently, one batched gather per (sender, recipient)
         # pair (reference _rebalance_move_data :6795 batches the same way)
@@ -1179,6 +1170,71 @@ class Scheduler(Server):
             *(move_batch(snd, rcp, tss) for (snd, rcp), tss in by_pair.items())
         )
         return {"status": "OK", "moves": sum(counts)}
+
+    @staticmethod
+    def _rebalance_plan_python(wss: list, keyset: set | None) -> list[tuple]:
+        """Sequential greedy move selection (reference scheduler.py:6605):
+        fullest senders shed their largest movable keys onto the emptiest
+        recipients until everyone sits inside the 5% band."""
+        mean = sum(ws.nbytes for ws in wss) / len(wss)
+        senders = sorted(
+            (ws for ws in wss if ws.nbytes > mean * 1.05),
+            key=lambda ws: -ws.nbytes,
+        )
+        recipients = sorted(
+            (ws for ws in wss if ws.nbytes < mean * 0.95),
+            key=lambda ws: ws.nbytes,
+        )
+        moves: list[tuple] = []  # (ts, sender, recipient)
+        projected = {ws: ws.nbytes for ws in wss}
+        for sender in senders:
+            for ts in sorted(sender.has_what, key=lambda t: -t.get_nbytes()):
+                if projected[sender] <= mean:
+                    break
+                if keyset is not None and ts.key not in keyset:
+                    continue
+                if ts.actor or len(ts.who_has) != 1 or ts.state != "memory":
+                    continue
+                if not recipients:
+                    break
+                recipient = recipients[0]
+                if projected[recipient] + ts.get_nbytes() > mean:
+                    recipients.sort(key=lambda ws: projected[ws])
+                    recipient = recipients[0]
+                    if projected[recipient] + ts.get_nbytes() > mean * 1.05:
+                        continue
+                moves.append((ts, sender, recipient))
+                projected[sender] -= ts.get_nbytes()
+                projected[recipient] += ts.get_nbytes()
+                recipients.sort(key=lambda ws: projected[ws])
+        return moves
+
+    @staticmethod
+    def _rebalance_plan_device(
+        wss: list, cand: list, owner: list[int]
+    ) -> list[tuple]:
+        """Vectorized move selection via the device kernel
+        (ops/rebalance.py): same invariants, Jacobi rounds instead of
+        the sequential greedy loop."""
+        import numpy as np
+
+        from distributed_tpu.ops.rebalance import (
+            RebalanceBatch,
+            plan_rebalance,
+        )
+
+        if not cand:
+            return []
+        batch = RebalanceBatch(
+            owner=np.asarray(owner, np.int32),
+            nbytes=np.asarray([ts.get_nbytes() for ts in cand], np.float32),
+            eligible=np.ones(len(cand), bool),
+            mem=np.asarray([ws.nbytes for ws in wss], np.float32),
+        )
+        return [
+            (cand[key_idx], wss[src], wss[dst])
+            for key_idx, src, dst in plan_rebalance(batch)
+        ]
 
     async def versions(self) -> dict:
         from distributed_tpu.versions import get_versions

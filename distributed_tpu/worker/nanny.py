@@ -161,7 +161,8 @@ class Nanny(Server):
                         "lifetime restart failed (attempt %d/%d)",
                         attempt, self.MAX_RESTART_ATTEMPTS,
                     )
-                    await asyncio.sleep(0.5 * attempt)
+                    if attempt < self.MAX_RESTART_ATTEMPTS:
+                        await asyncio.sleep(0.5 * attempt)
             else:
                 self.status = Status.failed
                 self._ongoing_background_tasks.call_soon(self.close)

@@ -299,3 +299,63 @@ async def test_device_amm_drop_live():
                     pytest.fail("device AMM round dropped nothing")
                 # data still gatherable after the trim
                 assert await c.gather(futs) == list(range(6))
+
+
+# ---------------------------------------------------------------- rebalance
+
+
+def _rebalance_setup(seed=0, N=400, W=16):
+    rng = np.random.default_rng(seed)
+    owner = rng.integers(0, W, N).astype(np.int32)
+    nbytes = rng.uniform(1e3, 1e7, N).astype(np.float32)
+    eligible = rng.random(N) < 0.9
+    # worker memory includes ineligible keys too (ws.nbytes does)
+    mem = np.zeros(W, np.float32)
+    np.add.at(mem, owner, nbytes)
+    # skew: first worker hoards extra
+    mem[0] += mem.sum()
+    return owner, nbytes, eligible, mem
+
+
+def test_rebalance_kernel_invariants_and_band():
+    from distributed_tpu.ops.rebalance import RebalanceBatch, plan_rebalance
+
+    owner, nbytes, eligible, mem = _rebalance_setup()
+    W = len(mem)
+    mean = mem.sum() / W
+    moves = plan_rebalance(
+        RebalanceBatch(owner, nbytes, eligible, mem.copy()), rounds=32
+    )
+    assert moves, "skewed memory must produce moves"
+    proj = mem.copy()
+    seen = set()
+    imbalance0 = proj.max() - proj.min()
+    for key, src, dst in moves:
+        assert key not in seen, "key moved twice"
+        seen.add(key)
+        assert eligible[key]
+        assert owner[key] == src
+        # python-policy invariants at application point
+        assert proj[src] > mean, "sender was not above the mean"
+        assert proj[dst] + nbytes[key] <= mean * 1.05 + 1, (
+            "recipient pushed past the 1.05 band"
+        )
+        proj[src] -= nbytes[key]
+        proj[dst] += nbytes[key]
+    assert proj.max() - proj.min() <= imbalance0, "imbalance grew"
+    # the hoarder actually drained toward the band
+    assert proj[0] < mem[0]
+
+
+def test_rebalance_kernel_noop_when_balanced():
+    from distributed_tpu.ops.rebalance import RebalanceBatch, plan_rebalance
+
+    rng = np.random.default_rng(1)
+    W, N = 8, 160
+    owner = np.repeat(np.arange(W), N // W).astype(np.int32)
+    nbytes = np.full(N, 1e5, np.float32)
+    mem = np.full(W, N // W * 1e5, np.float32)
+    moves = plan_rebalance(
+        RebalanceBatch(owner, nbytes, np.ones(N, bool), mem), rounds=8
+    )
+    assert moves == []

@@ -172,3 +172,31 @@ def test_client_executor_facade():
                 ex.shutdown(wait=False)
 
     aio.run(main())
+
+
+@gen_test(timeout=120)
+async def test_rebalance_device_path_evens_memory():
+    """Enough keys + the jax gates open -> move selection runs through
+    the device kernel (ops/rebalance.py) and still evens memory."""
+    from distributed_tpu import config
+
+    with config.set({"scheduler.jax.enabled": True,
+                     "scheduler.jax.min-workers": 0}):
+        async with await new_cluster(n_workers=2) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                w0 = cluster.workers[0].address
+                futs = c.map(
+                    lambda i: bytes(2_000), range(520), workers=[w0],
+                    pure=False,
+                )
+                await c.gather(futs)
+                assert len(cluster.workers[1].data) == 0
+                out = await c.rebalance()
+                assert out["moves"] > 0
+                for _ in range(100):
+                    if len(cluster.workers[1].data) > 0:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len(cluster.workers[1].data) > 0
+                results = await c.gather(futs)
+                assert all(len(r) == 2_000 for r in results)

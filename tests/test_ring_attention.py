@@ -86,3 +86,27 @@ def test_flash_kernel_rejects_ragged_blocks():
     q, k, v = _qkv(n=100, h=1, d=8)
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+@needs_mesh
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(causal):
+    from distributed_tpu.ops.ulysses import ulysses_attention
+
+    mesh = make_mesh_1d(8, axis="sp")
+    q, k, v = _qkv(n=256, h=8, d=16, seed=2)
+    out = ulysses_attention(mesh, q, k, v, axis="sp", causal=causal)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@needs_mesh
+def test_ulysses_rejects_indivisible_heads():
+    from distributed_tpu.ops.ulysses import ulysses_attention
+
+    mesh = make_mesh_1d(8, axis="sp")
+    q, k, v = _qkv(n=64, h=4, d=8)
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(mesh, q, k, v)
