@@ -27,7 +27,7 @@ from distributed_tpu.graph.spec import Graph, Key, TaskRef, TaskSpec, tokenize
 from distributed_tpu.protocol.serialize import Serialize, unwrap
 from distributed_tpu.rpc.batched import BatchedSend
 from distributed_tpu.rpc.core import raise_remote_error, rpc
-from distributed_tpu.utils.misc import LoopRunner, funcname, seq_name
+from distributed_tpu.utils.misc import LoopRunner, funcname, seq_name, time
 
 logger = logging.getLogger("distributed_tpu.client")
 
@@ -964,6 +964,27 @@ class Client:
     async def scheduler_info(self) -> dict:
         assert self.scheduler is not None
         return await self.scheduler.identity()
+
+    async def wait_for_workers(
+        self, n_workers: int, timeout: float | None = None
+    ) -> None:
+        """Block until ``n_workers`` are registered and running
+        (reference client.py wait_for_workers)."""
+        deadline = (time() + timeout) if timeout is not None else None
+        while True:
+            info = await self.scheduler_info()
+            workers = info.get("workers", {})
+            running = sum(
+                1 for w in workers.values()
+                if w.get("status", "running") == "running"
+            )
+            if running >= n_workers:
+                return
+            if deadline is not None and time() > deadline:
+                raise TimeoutError(
+                    f"only {running}/{n_workers} workers after {timeout}s"
+                )
+            await asyncio.sleep(0.05)
 
     def get_executor(self, **kwargs: Any):
         """concurrent.futures.Executor facade (reference client.py

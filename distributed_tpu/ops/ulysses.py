@@ -28,6 +28,20 @@ from jax.sharding import PartitionSpec as P
 from distributed_tpu.ops.ring_attention import reference_attention
 
 
+def _local_attention(q, k, v, causal: bool, scale: float):
+    """Full-sequence attention for this device's head group: the flash
+    kernel when the sequence divides by its blocks (O(block) memory —
+    the long-context regime this module exists for), else the plain
+    O(N^2) einsum for small/ragged shapes.  Shapes are static under
+    jit, so this branch resolves at trace time."""
+    n = q.shape[0]
+    if n % min(128, n) == 0:
+        from distributed_tpu.ops.flash import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
 @functools.lru_cache(maxsize=32)
 def _ulysses_program(mesh: Mesh, axis: str, causal: bool, scale: float):
     n_dev = mesh.shape[axis]
@@ -59,7 +73,7 @@ def _ulysses_program(mesh: Mesh, axis: str, causal: bool, scale: float):
         q = seq_to_heads(ql)
         k = seq_to_heads(kl)
         v = seq_to_heads(vl)
-        out = reference_attention(q, k, v, causal=causal, scale=scale)
+        out = _local_attention(q, k, v, causal, scale)
         return heads_to_seq(out)
 
     shard = jax.shard_map(

@@ -1,0 +1,39 @@
+"""Parallelism strategies over jax device meshes.
+
+``mesh`` builds the (tasks x workers) scheduler mesh and the sharded
+placement step.  Sequence/context parallelism for long-context workloads
+lives in ``ops``: ``ring_attention`` (K/V ring over ICI, lowest memory)
+and ``ulysses`` (all-to-all seq<->head re-sharding, fewest collectives)
+— re-exported here as the canonical entry points.
+"""
+
+from __future__ import annotations
+
+from distributed_tpu.parallel.mesh import make_mesh, sharded_decide_workers
+
+
+def __getattr__(name: str):
+    if name == "ring_attention":
+        from distributed_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention
+    if name == "ulysses_attention":
+        from distributed_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention
+    if name == "flash_attention":
+        from distributed_tpu.ops.flash import flash_attention
+
+        return flash_attention
+    raise AttributeError(
+        f"module 'distributed_tpu.parallel' has no attribute {name!r}"
+    )
+
+
+__all__ = [
+    "make_mesh",
+    "sharded_decide_workers",
+    "ring_attention",
+    "ulysses_attention",
+    "flash_attention",
+]

@@ -1413,6 +1413,7 @@ class Scheduler(Server):
                     "name": ws.name,
                     "nthreads": ws.nthreads,
                     "memory_limit": ws.memory_limit,
+                    "status": str(getattr(ws, "status", "running")),
                 }
                 for addr, ws in self.state.workers.items()
             },

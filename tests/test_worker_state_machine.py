@@ -418,3 +418,123 @@ def test_compute_cancel_recompute_before_first_tick():
         w.executor.shutdown(wait=False)
 
     asyncio.run(main())
+
+
+def test_reschedule_releases_and_notifies(ws):
+    """An executing task that raises Reschedule goes back to the
+    scheduler (reference wsm test_reschedule)."""
+    from distributed_tpu.worker.state_machine import (
+        RescheduleEvent,
+        RescheduleMsg,
+    )
+
+    ws.handle_stimulus(ComputeTaskEvent.dummy("r1", priority=(0,)))
+    assert ws.tasks["r1"].state == "executing"
+    instrs = ws.handle_stimulus(RescheduleEvent(stimulus_id="s-res", key="r1"))
+    assert [type(i) for i in instrs] == [RescheduleMsg]
+    assert "r1" not in ws.data
+    assert ws.tasks.get("r1") is None or ws.tasks["r1"].state == "released"
+
+
+def test_acquire_replicas_fetches_and_announces(ws):
+    """AMM acquire-replicas: the worker fetches keys it was told about
+    and announces them on arrival (reference wsm.py AcquireReplicas)."""
+    from distributed_tpu.worker.state_machine import AcquireReplicasEvent
+
+    instrs = ws.handle_stimulus(
+        AcquireReplicasEvent(
+            stimulus_id="s-acq",
+            who_has={"rep": ["tcp://peer:1"]},
+            nbytes={"rep": 8},
+        )
+    )
+    gathers = [i for i in instrs if isinstance(i, GatherDep)]
+    assert len(gathers) == 1
+    assert ws.tasks["rep"].state == "flight"
+    instrs = ws.handle_stimulus(
+        GatherDepSuccessEvent(
+            stimulus_id="s-got", worker="tcp://peer:1",
+            data={"rep": 123}, total_nbytes=8,
+        )
+    )
+    assert ws.data["rep"] == 123
+    assert ws.tasks["rep"].state == "memory"
+    assert any(isinstance(i, AddKeysMsg) for i in instrs)
+
+
+def test_remove_replicas_drops_unwanted_data(ws):
+    """AMM remove-replicas drops keys no dependent needs."""
+    from distributed_tpu.worker.state_machine import RemoveReplicasEvent
+
+    ws.handle_stimulus(
+        UpdateDataEvent(stimulus_id="s-up", data={"d1": 1, "d2": 2},
+                        report=False)
+    )
+    assert ws.data["d1"] == 1
+    ws.handle_stimulus(RemoveReplicasEvent(stimulus_id="s-rm", keys=("d1",)))
+    assert "d1" not in ws.data
+    assert "d2" in ws.data
+    ws.validate_state()
+
+
+def test_gather_dep_failure_errors_dependents(ws):
+    """A local failure while receiving (e.g. deserialization) errors the
+    dependent instead of retrying forever (reference wsm.py
+    GatherDepFailureEvent)."""
+    from distributed_tpu.worker.state_machine import GatherDepFailureEvent
+
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "child-g", priority=(0,),
+            who_has={"parent-g": ["tcp://peer:1"]}, nbytes={"parent-g": 8},
+        )
+    )
+    assert ws.tasks["parent-g"].state == "flight"
+    instrs = ws.handle_stimulus(
+        GatherDepFailureEvent(
+            stimulus_id="s-fail", worker="tcp://peer:1", keys=("parent-g",),
+            exception=TypeError("cannot deserialize"),
+        )
+    )
+    assert ws.tasks["parent-g"].state == "error"
+    # the dependent cannot run; it reports erred to the scheduler
+    assert any(isinstance(i, TaskErredMsg) for i in instrs)
+
+
+def test_compute_with_data_already_local_skips_fetch(ws):
+    """Dependencies already in memory never produce a GatherDep."""
+    ws.handle_stimulus(
+        UpdateDataEvent(stimulus_id="s-up", data={"dep-l": 7}, report=False)
+    )
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "child-l", priority=(0,),
+            who_has={"dep-l": ["tcp://peer:1"]}, nbytes={"dep-l": 8},
+        )
+    )
+    assert not [i for i in instrs if isinstance(i, GatherDep)]
+    assert ws.tasks["child-l"].state == "executing"
+
+
+def test_free_keys_in_flight_then_late_arrival_dropped(ws):
+    """free-keys for an in-flight fetch: the arriving payload must not
+    resurrect the task (cancelled-flight contract)."""
+    ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "child-f", priority=(0,),
+            who_has={"dep-f": ["tcp://peer:1"]}, nbytes={"dep-f": 8},
+        )
+    )
+    assert ws.tasks["dep-f"].state == "flight"
+    ws.handle_stimulus(
+        FreeKeysEvent(stimulus_id="s-free", keys=("child-f", "dep-f"))
+    )
+    instrs = ws.handle_stimulus(
+        GatherDepSuccessEvent(
+            stimulus_id="s-late", worker="tcp://peer:1",
+            data={"dep-f": 9}, total_nbytes=8,
+        )
+    )
+    assert "dep-f" not in ws.data or ws.tasks.get("dep-f") is None
+    assert not any(isinstance(i, AddKeysMsg) for i in instrs)
+    ws.validate_state()
