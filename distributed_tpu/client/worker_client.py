@@ -18,20 +18,31 @@ from distributed_tpu.utils.misc import seq_name
 
 def secede() -> None:
     """Remove the current task from its worker thread slot
-    (reference worker.py:2799, threadpoolexecutor.py:70)."""
-    from distributed_tpu.worker.context import get_thread_key, get_worker
+    (reference worker.py:2799, threadpoolexecutor.py:70).
+
+    Works from executor-thread task bodies AND from coroutine task
+    bodies on the worker's event loop (the key rides a contextvar
+    there); only the thread flavor grows the OS pool — a coroutine
+    holds no thread."""
+    from distributed_tpu.worker.context import (
+        get_task_key,
+        get_thread_key,
+        get_worker,
+    )
     from distributed_tpu.worker.state_machine import LongRunningEvent
 
     worker = get_worker()
-    key = get_thread_key()
+    key = get_task_key()
     if key is None:
         raise ValueError("secede() must be called from inside a task")
-    worker.loop.call_soon_threadsafe(
-        worker.handle_stimulus,
-        LongRunningEvent(
-            stimulus_id=seq_name("secede"), key=key, compute_duration=0.0
-        ),
+    event = LongRunningEvent(
+        stimulus_id=seq_name("secede"), key=key, compute_duration=0.0
     )
+    if get_thread_key() is None:
+        # coroutine body: already on the worker's loop
+        worker.handle_stimulus(event)
+        return
+    worker.loop.call_soon_threadsafe(worker.handle_stimulus, event)
     # free the OS thread too: the state machine released the slot, but this
     # thread stays blocked in the task body — grow the pool so another task
     # can actually run (reference threadpoolexecutor.py:70 grows the same way)

@@ -1921,7 +1921,15 @@ class SchedulerState:
                 if "retries" in ann:
                     ts.retries = ann["retries"]
                 if "priority" in ann and ts.priority is not None:
-                    ts.priority = (-ann["priority"],) + ts.priority[1:]
+                    new_pri = (-ann["priority"],) + ts.priority[1:]
+                    if new_pri != ts.priority and ts in self.queued:
+                        # HeapSet orders by add-time priority: re-add so
+                        # the bump is visible to peekn/pop, not stale
+                        self.queued.remove(ts)
+                        ts.priority = new_pri
+                        self.queued.add(ts)
+                    else:
+                        ts.priority = new_pri
             if (actors is True) or (isinstance(actors, list) and key in actors):
                 ts.actor = True
 

@@ -224,7 +224,19 @@ class ShuffleRun:
         once: a second request means a recomputed unpack would get an
         empty partition, so the run fails for an epoch restart instead."""
         self.touch()
-        await asyncio.wait_for(self.inputs_done.wait(), timeout)
+        if not self.inputs_done.is_set():
+            # about to block on EXTERNAL progress (the barrier needs every
+            # transfer to finish): leave the execution slot first, or a
+            # dep-free recomputed unpack wedges a 1-thread worker whose
+            # queue holds the very transfer the barrier is waiting for
+            # (measured deadlock-until-timeout under epoch restarts)
+            try:
+                from distributed_tpu.client.worker_client import secede
+
+                secede()
+            except ValueError:
+                pass  # rpc handler path (shuffle_fetch_output): no task slot
+            await asyncio.wait_for(self.inputs_done.wait(), timeout)
         self.touch()
         if j in self.outputs_served:
             raise ShuffleClosedError(

@@ -22,6 +22,10 @@ class HeapSet(Generic[T]):
     ``key(el)`` must return a totally-ordered priority; lower pops first.
     Membership, add and discard are O(1)/O(log n); stale heap entries are
     lazily skipped on pop/peek (same design as the reference's HeapSet).
+
+    Contract: an element's priority is snapshotted at ``add`` time.  If
+    it must change while the element is in the set, ``remove`` then
+    ``add`` it — in-place mutation leaves the heap ordering stale.
     """
 
     def __init__(self, *, key: Callable[[T], Any]):
@@ -106,10 +110,26 @@ class HeapSet(Generic[T]):
         """Iterate over the n smallest elements without removing them.
 
         Non-destructive: the caller may add/discard freely while iterating.
+
+        Reuses the priorities already stored in the heap — a key-function
+        scan of the whole set here (heapq.nsmallest over _data) showed up
+        as the scheduler's single hottest line, because this runs with
+        n = open slots on EVERY task completion while the queue is long.
         """
         if n <= 0 or not self._data:
             return iter(())
-        return iter(heapq.nsmallest(n, list(self._data), key=self.key))
+        if n == 1:
+            return iter((self.peek(),))
+        heap = self._heap.copy()  # O(Q), zero key() calls
+        out: list[T] = []
+        seen: set[int] = set()  # re-added elements leave duplicate entries
+        while heap and len(out) < n:
+            _, _, ref = heapq.heappop(heap)
+            el = ref()
+            if el is not None and el in self._data and id(el) not in seen:
+                seen.add(id(el))
+                out.append(el)
+        return iter(out)
 
     def sorted(self) -> list[T]:
         return sorted(self._data, key=self.key)

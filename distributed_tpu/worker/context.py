@@ -20,6 +20,9 @@ _thread_state = threading.local()
 _async_worker: contextvars.ContextVar = contextvars.ContextVar(
     "dtpu_worker", default=None
 )
+_async_key: contextvars.ContextVar = contextvars.ContextVar(
+    "dtpu_task_key", default=None
+)
 
 
 def set_thread_worker(worker: "Worker", key: str | None = None) -> None:
@@ -31,12 +34,23 @@ def get_thread_key() -> str | None:
     return getattr(_thread_state, "key", None)
 
 
-def set_async_worker(worker: "Worker"):
-    return _async_worker.set(worker)
+def set_async_worker(worker: "Worker", key: str | None = None):
+    return _async_worker.set(worker), _async_key.set(key)
 
 
 def reset_async_worker(token) -> None:
-    _async_worker.reset(token)
+    t1, t2 = token
+    _async_worker.reset(t1)
+    _async_key.reset(t2)
+
+
+def get_task_key() -> str | None:
+    """The key of the currently-executing task: thread-local for executor
+    tasks, contextvar for coroutine bodies on the event loop."""
+    key = getattr(_thread_state, "key", None)
+    if key is not None:
+        return key
+    return _async_key.get()
 
 
 def get_worker() -> "Worker":

@@ -686,7 +686,7 @@ class Worker(Server):
                         set_async_worker,
                     )
 
-                    token = set_async_worker(self)
+                    token = set_async_worker(self, key)
                     try:
                         value = await fn(*args, **kwargs)
                     finally:

@@ -27,6 +27,7 @@ Scheduling-within-worker mirrors the reference:
 
 from __future__ import annotations
 
+import functools
 import logging
 import random
 from collections import defaultdict, deque
@@ -614,9 +615,21 @@ class WorkerState:
 
     def _handle_long_running(self, ev: LongRunningEvent) -> tuple[Recs, Instructions]:
         ts = self.tasks.get(ev.key)
-        if ts is None or ts.state not in ("executing",):
+        if ts is None:
             return {}, []
-        return {ts: ("long-running", ev)}, []
+        if ts.state == "executing":
+            return {ts: ("long-running", ev)}, []
+        if ts.state in ("cancelled", "resumed") and ts.previous == "executing":
+            # the cancelled/resumed body is still running and just
+            # seceded: free the slot NOW (the whole point of seceding)
+            # and remember it as long-running so completion accounting
+            # stays right (reference wsm.py sets previous accordingly —
+            # dropping the event here re-wedges the worker the shuffle
+            # secede fix exists for)
+            self.executing.discard(ts)
+            self.long_running.add(ts)
+            ts.previous = "long-running"
+        return {}, []
 
     def _handle_gather_dep_success(self, ev: GatherDepSuccessEvent) -> tuple[Recs, Instructions]:
         recs: Recs = {}
@@ -1394,7 +1407,10 @@ class WorkerState:
         return [entry for entry in self.log if entry[0] in keys]
 
 
+@functools.lru_cache(maxsize=None)
 def _snake(name: str) -> str:
+    # cached: runs once per event CLASS, not once per stimulus (this sat
+    # near the top of the trivial-task profile before)
     out = []
     for i, c in enumerate(name):
         if c.isupper() and i:

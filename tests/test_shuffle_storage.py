@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time as _time
 
 import numpy as np
 import pytest
@@ -265,3 +266,49 @@ async def test_duplicate_output_fetch_restarts_instead_of_empty():
             )
             assert sorted(part) == expect
             assert st.run_id > run_before
+
+
+@gen_test(timeout=120)
+async def test_dep_free_unpack_cannot_wedge_single_thread_worker():
+    """Regression: a recomputed unpack with NO graph dependencies lands
+    on a 1-thread worker and waits for the barrier — the transfers the
+    barrier needs are queued BEHIND it on the same worker.  The unpack
+    must secede (long-running) before blocking, or the worker wedges
+    until the 30s collect timeout (measured deadlock)."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            ext = cluster.scheduler.extensions["shuffle"]
+            inputs = [
+                c.submit(big_partition, i, key=f"sin-{i}") for i in range(4)
+            ]
+            await c.gather(inputs)
+            outs = await p2p_shuffle(c, inputs, npartitions_out=2)
+            await asyncio.wait_for(c.gather(outs), 60)
+            sid = next(iter(ext.active))
+            run_before = ext.active[sid].run_id
+            key0 = outs[0].key
+            outs[0].release()
+            for _ in range(100):
+                if key0 not in cluster.scheduler.state.tasks:
+                    break
+                await asyncio.sleep(0.05)
+
+            from distributed_tpu.graph.spec import TaskSpec
+            from distributed_tpu.shuffle.api import shuffle_unpack
+
+            t0 = _time.monotonic()
+            futs = c._graph_to_futures(
+                {key0: TaskSpec(shuffle_unpack, (sid, 0, run_before))},
+                [key0],
+            )
+            part = await asyncio.wait_for(futs[key0].result(), 90)
+            elapsed = _time.monotonic() - t0
+            expect = sorted(
+                x
+                for i in range(4)
+                for x in big_partition(i)
+                if hash(x) % 2 == 0
+            )
+            assert sorted(part) == expect
+            # the deadlock variant only completes via the 30s timeout path
+            assert elapsed < 25, f"unpack took {elapsed:.1f}s: worker wedged"

@@ -538,3 +538,28 @@ def test_free_keys_in_flight_then_late_arrival_dropped(ws):
     assert "dep-f" not in ws.data or ws.tasks.get("dep-f") is None
     assert not any(isinstance(i, AddKeysMsg) for i in instrs)
     ws.validate_state()
+
+
+def test_secede_of_cancelled_task_frees_slot(ws):
+    """A cancelled-but-still-running task that secedes must release its
+    execution slot (the shuffle deadlock fix depends on it): previous
+    flips to long-running and queued work starts."""
+    from distributed_tpu.worker.state_machine import LongRunningEvent
+
+    ws.handle_stimulus(ComputeTaskEvent.dummy("c0", priority=(0,)))
+    ws.handle_stimulus(ComputeTaskEvent.dummy("c1", priority=(1,)))
+    ws.handle_stimulus(ComputeTaskEvent.dummy("c2", priority=(2,)))
+    assert ws.tasks["c2"].state == "ready"  # both slots busy
+    # cancel c0 while it runs: stays in 'cancelled', slot still held
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="s-free", keys=("c0",)))
+    assert ws.tasks["c0"].state == "cancelled"
+    assert ws.tasks["c2"].state == "ready"
+    # the running body secedes: slot frees, c2 starts
+    instrs = ws.handle_stimulus(
+        LongRunningEvent(stimulus_id="s-sec", key="c0", compute_duration=0.0)
+    )
+    assert ws.tasks["c0"].previous == "long-running"
+    assert ws.tasks["c2"].state == "executing"
+    # eventual completion of the cancelled body is still clean
+    finish_exec(ws, "c0")
+    ws.validate_state()
