@@ -173,6 +173,7 @@ class Client:
         )
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
         self.futures: dict[Key, FutureState] = {}
+        self._expected_restart_reports = 0
         # pickled-size cache for the large-closure warning: weak keys so
         # user functions die normally and ids are never reused stale
         import weakref
@@ -325,8 +326,16 @@ class Client:
                                 logger.exception("event handler failed")
                     elif op in ("stream-closed", "close", "restart"):
                         if op == "restart":
-                            for st in self.futures.values():
-                                st.cancel()
+                            # the initiating client already cancelled its
+                            # futures synchronously in restart(); its own
+                            # echo must not cancel work submitted since
+                            # (the report rides the stream, unordered
+                            # with the restart rpc reply)
+                            if self._expected_restart_reports > 0:
+                                self._expected_restart_reports -= 1
+                            else:
+                                for st in self.futures.values():
+                                    st.cancel()
                         if op != "restart":
                             return
         except (CommClosedError, asyncio.CancelledError):
@@ -762,7 +771,17 @@ class Client:
 
     async def restart(self) -> None:
         assert self.scheduler is not None
-        await self.scheduler.restart()
+        self._expected_restart_reports += 1
+        try:
+            await self.scheduler.restart()
+        except BaseException:
+            # rpc failed: no echo is coming (or it already cancelled for
+            # us) — a leaked counter would swallow a FUTURE externally-
+            # initiated restart's report
+            self._expected_restart_reports = max(
+                0, self._expected_restart_reports - 1
+            )
+            raise
         for st in self.futures.values():
             st.cancel()
 

@@ -25,7 +25,9 @@ class HeapSet(Generic[T]):
 
     Contract: an element's priority is snapshotted at ``add`` time.  If
     it must change while the element is in the set, ``remove`` then
-    ``add`` it — in-place mutation leaves the heap ordering stale.
+    ``add`` it — each element's LATEST add is the only live heap entry
+    (a per-element token invalidates older ones), so re-adds reorder
+    correctly in both directions.
     """
 
     def __init__(self, *, key: Callable[[T], Any]):
@@ -33,6 +35,7 @@ class HeapSet(Generic[T]):
         self._data: set[T] = set()
         self._heap: list[tuple[Any, int, Any]] = []
         self._inc = 0
+        self._token: dict[T, int] = {}  # element -> inc of its live entry
 
     def __len__(self) -> int:
         return len(self._data)
@@ -51,6 +54,7 @@ class HeapSet(Generic[T]):
             return
         self._inc += 1
         self._data.add(el)
+        self._token[el] = self._inc
         try:
             ref: Any = weakref.ref(el)
         except TypeError:
@@ -59,17 +63,26 @@ class HeapSet(Generic[T]):
 
     def discard(self, el: T) -> None:
         self._data.discard(el)
+        self._token.pop(el, None)
         if not self._data:
             self._heap.clear()
         elif len(self._heap) > 2 * len(self._data) + 64:
             self._prune()
 
+    def _live(self, inc: int, ref: Any) -> "T | None":
+        """Resolve a heap entry to its element iff it is the element's
+        LATEST add (stale entries from remove+add must lose, or an old
+        smaller priority would shadow a deprioritization)."""
+        el = ref()
+        if el is not None and self._token.get(el) == inc:
+            return el
+        return None
+
     def _prune(self) -> None:
         """Drop stale heap entries so churn doesn't pin discarded elements."""
         live = [
-            entry
-            for entry in self._heap
-            if (el := entry[2]()) is not None and el in self._data
+            entry for entry in self._heap
+            if self._live(entry[1], entry[2]) is not None
         ]
         heapq.heapify(live)
         self._heap = live
@@ -83,8 +96,8 @@ class HeapSet(Generic[T]):
         if not self._data:
             raise KeyError("peek into empty set")
         while True:
-            el = self._heap[0][2]()
-            if el is not None and el in self._data:
+            el = self._live(self._heap[0][1], self._heap[0][2])
+            if el is not None:
                 return el
             heapq.heappop(self._heap)
 
@@ -92,10 +105,11 @@ class HeapSet(Generic[T]):
         if not self._data:
             raise KeyError("pop from an empty set")
         while True:
-            _, _, ref = heapq.heappop(self._heap)
-            el = ref()
-            if el is not None and el in self._data:
+            _, inc, ref = heapq.heappop(self._heap)
+            el = self._live(inc, ref)
+            if el is not None:
                 self._data.discard(el)
+                self._token.pop(el, None)
                 return el
 
     def popright(self) -> T:
@@ -122,12 +136,10 @@ class HeapSet(Generic[T]):
             return iter((self.peek(),))
         heap = self._heap.copy()  # O(Q), zero key() calls
         out: list[T] = []
-        seen: set[int] = set()  # re-added elements leave duplicate entries
         while heap and len(out) < n:
-            _, _, ref = heapq.heappop(heap)
-            el = ref()
-            if el is not None and el in self._data and id(el) not in seen:
-                seen.add(id(el))
+            _, inc, ref = heapq.heappop(heap)
+            el = self._live(inc, ref)
+            if el is not None:
                 out.append(el)
         return iter(out)
 
@@ -140,6 +152,7 @@ class HeapSet(Generic[T]):
     def clear(self) -> None:
         self._data.clear()
         self._heap.clear()
+        self._token.clear()
 
 
 class LRU(OrderedDict):

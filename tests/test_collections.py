@@ -68,3 +68,34 @@ def test_lru():
     assert lru["b"] == 2
     lru["d"] = 4
     assert "c" not in lru  # b was touched, c evicted
+
+
+def test_heapset_readd_reorders_both_directions():
+    """remove+add with a changed priority must be fully visible to
+    peek/pop/peekn — stale entries (old priority, either direction)
+    lose to the element's latest add."""
+    from distributed_tpu.utils import HeapSet
+
+    class El:
+        def __init__(self, name, pri):
+            self.name = name
+            self.pri = pri
+
+    h = HeapSet(key=lambda e: e.pri)
+    a, b = El("a", 5), El("b", 3)
+    h.add(a)
+    h.add(b)
+    assert h.peek() is b
+    # deprioritize b below a: the old (3) entry must not shadow it
+    h.remove(b)
+    b.pri = 9
+    h.add(b)
+    assert h.peek() is a
+    assert [e.name for e in h.peekn(2)] == ["a", "b"]
+    # and prioritization works too
+    h.remove(b)
+    b.pri = 1
+    h.add(b)
+    assert [e.name for e in h.peekn(2)] == ["b", "a"]
+    assert h.pop() is b
+    assert h.pop() is a

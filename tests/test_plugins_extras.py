@@ -200,3 +200,70 @@ async def test_rebalance_device_path_evens_memory():
                 assert len(cluster.workers[1].data) > 0
                 results = await c.gather(futs)
                 assert all(len(r) == 2_000 for r in results)
+
+
+@gen_test()
+async def test_client_restart_clears_state_and_cluster_still_works():
+    """client.restart(): all tasks forgotten, pending futures cancelled,
+    the cluster keeps working (reference test_client.py::test_restart)."""
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x * 2, range(6))
+            assert await c.gather(futs) == [x * 2 for x in range(6)]
+            await c.restart()
+            for _ in range(100):
+                if not cluster.scheduler.state.tasks:
+                    break
+                await asyncio.sleep(0.02)
+            assert not cluster.scheduler.state.tasks
+            assert all(f.status in ("cancelled", "lost") for f in futs)
+            # fresh work proceeds
+            assert await c.submit(lambda: 9).result() == 9
+
+
+@gen_test()
+async def test_upload_file_imports_on_workers():
+    """client.upload_file ships a module to every worker, current and
+    future (reference test_client.py::test_upload_file)."""
+    import os
+    import sys
+    import tempfile
+    import textwrap
+
+    from distributed_tpu.worker.server import Worker
+
+    with tempfile.TemporaryDirectory() as td:
+        mod = os.path.join(td, "dtpu_uploaded_mod.py")
+        with open(mod, "w") as f:
+            f.write(textwrap.dedent("""
+                def quadruple(x):
+                    return x * 4
+                """))
+        try:
+            async with await new_cluster(n_workers=1) as cluster:
+                async with Client(cluster.scheduler_address) as c:
+                    await c.upload_file(mod)
+
+                    def use_it(x):
+                        import dtpu_uploaded_mod
+
+                        return dtpu_uploaded_mod.quadruple(x)
+
+                    assert await c.submit(use_it, 5).result() == 20
+                    # a LATE worker gets the file too (plugin re-runs on join)
+                    w2 = Worker(cluster.scheduler_address, nthreads=1)
+                    await w2.start()
+                    try:
+                        assert await c.submit(
+                            use_it, 7, workers=[w2.address]
+                        ).result() == 28
+                    finally:
+                        await w2.close()
+        finally:
+            # UploadFile writes into the WORKER's cwd (this process for
+            # in-proc workers): a leftover copy would make later runs
+            # pass vacuously off the stale file
+            sys.modules.pop("dtpu_uploaded_mod", None)
+            stray = os.path.join(os.getcwd(), "dtpu_uploaded_mod.py")
+            if os.path.exists(stray):
+                os.remove(stray)
