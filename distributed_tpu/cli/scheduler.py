@@ -31,6 +31,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "(reference scheduler.py:3663 --jupyter)")
     p.add_argument("--jupyter-port", type=int, default=8888,
                    help="port for the Jupyter server (with --jupyter)")
+    p.add_argument("--tls-ca-file", default=None,
+                   help="CA certificate for TLS (with --protocol tls)")
+    p.add_argument("--tls-cert", default=None, help="scheduler TLS certificate")
+    p.add_argument("--tls-key", default=None, help="scheduler TLS private key")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p
@@ -46,6 +50,23 @@ async def run(args: argparse.Namespace) -> int:
         kwargs["idle_timeout"] = config.parse_timedelta(args.idle_timeout)
     if args.worker_ttl is not None:
         kwargs["worker_ttl"] = config.parse_timedelta(args.worker_ttl)
+    if args.tls_ca_file or args.tls_cert:
+        from distributed_tpu.security import Security
+
+        kwargs["security"] = Security(
+            tls_ca_file=args.tls_ca_file,
+            tls_scheduler_cert=args.tls_cert,
+            tls_scheduler_key=args.tls_key,
+            require_encryption=True,
+        )
+        if args.protocol == "tcp":
+            # certs given but protocol left at the default: a tcp://
+            # listener would silently drop the ssl context and run the
+            # whole cluster in plaintext — infer tls like the reference
+            logging.getLogger("distributed_tpu.cli").info(
+                "TLS credentials given: using protocol tls"
+            )
+            args.protocol = "tls"
     scheduler = Scheduler(
         listen_addr=f"{args.protocol}://{args.host}:{args.port}", **kwargs
     )

@@ -50,6 +50,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-lifetime-restart", dest="lifetime_restart",
                    action="store_false",
                    help="override a config-enabled lifetime restart")
+    p.add_argument("--tls-ca-file", default=None,
+                   help="CA certificate for TLS (tls:// scheduler address)")
+    p.add_argument("--tls-cert", default=None, help="worker TLS certificate")
+    p.add_argument("--tls-key", default=None, help="worker TLS private key")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p
@@ -84,7 +88,31 @@ async def run(args: argparse.Namespace) -> int:
         from distributed_tpu.utils.system import outbound_ip
 
         host = outbound_ip(args.scheduler)
-    listen_addr = f"tcp://{host}:0" if host else None
+    # match the scheduler's transport: a tls:// control plane means the
+    # worker must serve its peers over tls too
+    proto = args.scheduler.split("://", 1)[0] if "://" in args.scheduler else "tcp"
+    if host:
+        listen_addr = f"{proto}://{host}:0"
+    elif proto != "tcp":
+        listen_addr = f"{proto}://127.0.0.1:0"
+    else:
+        listen_addr = None
+    security = None
+    if args.tls_ca_file or args.tls_cert:
+        from distributed_tpu.security import Security
+
+        security = Security(
+            tls_ca_file=args.tls_ca_file,
+            tls_worker_cert=args.tls_cert,
+            tls_worker_key=args.tls_key,
+            require_encryption=True,
+        )
+        if proto != "tls":
+            logging.getLogger("distributed_tpu.cli").warning(
+                "TLS credentials given but the scheduler address is %s://"
+                " — traffic will NOT be encrypted; use a tls:// address",
+                proto,
+            )
 
     servers = []
     all_preloads = []
@@ -98,6 +126,8 @@ async def run(args: argparse.Namespace) -> int:
             worker_kwargs["resources"] = resources
         if listen_addr:
             worker_kwargs["listen_addr"] = listen_addr
+        if security is not None:
+            worker_kwargs["security"] = security
         if args.nanny:
             server = Nanny(
                 args.scheduler,
@@ -108,6 +138,7 @@ async def run(args: argparse.Namespace) -> int:
                 lifetime=lifetime,
                 lifetime_stagger=lifetime_stagger,
                 lifetime_restart=args.lifetime_restart,
+                security=security,
             )
         else:
             server = Worker(

@@ -173,7 +173,6 @@ class Client:
         )
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
         self.futures: dict[Key, FutureState] = {}
-        self._expected_restart_reports = 0
         # pickled-size cache for the large-closure warning: weak keys so
         # user functions die normally and ids are never reused stale
         import weakref
@@ -326,14 +325,11 @@ class Client:
                                 logger.exception("event handler failed")
                     elif op in ("stream-closed", "close", "restart"):
                         if op == "restart":
-                            # the initiating client already cancelled its
-                            # futures synchronously in restart(); its own
-                            # echo must not cancel work submitted since
-                            # (the report rides the stream, unordered
-                            # with the restart rpc reply)
-                            if self._expected_restart_reports > 0:
-                                self._expected_restart_reports -= 1
-                            else:
+                            # the initiating client cancels its futures
+                            # in restart() itself; its tagged echo must
+                            # not cancel work submitted since (the
+                            # report stream is unordered with the rpc)
+                            if msg.get("initiator") != self.id:
                                 for st in self.futures.values():
                                     st.cancel()
                         if op != "restart":
@@ -770,20 +766,20 @@ class Client:
         return unwrap(resp.get("result"))
 
     async def restart(self) -> None:
+        """Forget every task cluster-wide; cancel this client's futures.
+
+        The report stream is unordered with the rpc reply, so the echo
+        is initiator-tagged and skipped here (a counter would leak on
+        rpc failure).  Futures cancel in a finally: restart's intent is
+        cancel-everything, and on an rpc failure the scheduler may or
+        may not have restarted — pending futures must not hang either
+        way."""
         assert self.scheduler is not None
-        self._expected_restart_reports += 1
         try:
-            await self.scheduler.restart()
-        except BaseException:
-            # rpc failed: no echo is coming (or it already cancelled for
-            # us) — a leaked counter would swallow a FUTURE externally-
-            # initiated restart's report
-            self._expected_restart_reports = max(
-                0, self._expected_restart_reports - 1
-            )
-            raise
-        for st in self.futures.values():
-            st.cancel()
+            await self.scheduler.restart(client=self.id)
+        finally:
+            for st in self.futures.values():
+                st.cancel()
 
     async def rebalance(self, futures: Iterable[Future] | None = None,
                         workers: list[str] | None = None) -> dict:

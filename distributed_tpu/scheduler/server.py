@@ -886,13 +886,17 @@ class Scheduler(Server):
         self.send_all(client_msgs, worker_msgs)
         return list(keys)
 
-    async def restart(self, **kwargs: Any) -> str:
-        """Forget all tasks; clear cluster state (reference scheduler.py:6193)."""
+    async def restart(self, client: str = "", **kwargs: Any) -> str:
+        """Forget all tasks; clear cluster state (reference scheduler.py:6193).
+
+        The report carries the initiating client's id so that client can
+        ignore its own echo (it cancels its futures synchronously)."""
         stimulus_id = seq_name("restart")
         for cs in list(self.state.clients.values()):
             if cs.client_key in self.client_comms:
                 self.client_comms[cs.client_key].send(
-                    {"op": "restart", "stimulus_id": stimulus_id}
+                    {"op": "restart", "stimulus_id": stimulus_id,
+                     "initiator": client}
                 )
         for addr in list(self.state.workers):
             self.send_all({}, {addr: [{"op": "free-keys",

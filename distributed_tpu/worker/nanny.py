@@ -65,8 +65,16 @@ class Nanny(Server):
         lifetime: float | None = None,
         lifetime_stagger: float | None = None,
         lifetime_restart: bool | None = None,
+        security: Any | None = None,
         **server_kwargs: Any,
     ):
+        self.security = security
+        if security is not None:
+            # the nanny's own control channel (kill/restart/terminate)
+            # and its scheduler rpc must ride TLS like everything else
+            server_kwargs.setdefault(
+                "connection_args", security.get_connection_args("worker")
+            )
         self.scheduler_addr = scheduler_addr
         self.nthreads = nthreads
         self.worker_name = name
@@ -100,8 +108,15 @@ class Nanny(Server):
     # ------------------------------------------------------------ lifecycle
 
     async def start_unsafe(self) -> "Nanny":
-        addr = self._listen_addr or "tcp://127.0.0.1:0"
-        await self.listen(addr)
+        addr = self._listen_addr or (
+            "tls://127.0.0.1:0" if self.security is not None
+            else "tcp://127.0.0.1:0"
+        )
+        listen_args = (
+            self.security.get_listen_args("worker")
+            if self.security is not None else {}
+        )
+        await self.listen(addr, **listen_args)
         await self.instantiate()
         if self.memory_limit:
             from distributed_tpu.worker.memory import NannyMemoryManager
@@ -181,6 +196,8 @@ class Nanny(Server):
         # the NANNY owns the lifetime (it can restart); zero the child's
         # own config-read timer or both would fire independently
         kwargs.setdefault("lifetime", 0)
+        if self.security is not None:
+            kwargs.setdefault("security", self.security)
         env = dict(config.get("nanny.pre-spawn-environ") or {})
         env.update(self.env)
         self.process = AsyncProcess(
