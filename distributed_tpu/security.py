@@ -35,16 +35,22 @@ class Security:
         if require_encryption is None:
             require_encryption = bool(config.get("comm.require-encryption") or False)
         self.require_encryption = require_encryption
-        self.tls_ca_file = kwargs.get("tls_ca_file", config.get("comm.tls.ca-file"))
-        self.tls_ciphers = kwargs.get("tls_ciphers", config.get("comm.tls.ciphers"))
-        self.tls_min_version = kwargs.get("tls_min_version",
-                                          config.get("comm.tls.min-version"))
+
+        def opt(name: str, config_key: str):
+            # None (absent OR passed explicitly, e.g. an unset CLI flag)
+            # always falls back to config — a present-but-None kwarg must
+            # not mask a configured credential
+            v = kwargs.get(name)
+            return v if v is not None else config.get(config_key)
+
+        self.tls_ca_file = opt("tls_ca_file", "comm.tls.ca-file")
+        self.tls_ciphers = opt("tls_ciphers", "comm.tls.ciphers")
+        self.tls_min_version = opt("tls_min_version", "comm.tls.min-version")
         for role in _ROLES:
             for kind in ("cert", "key"):
                 attr = f"tls_{role}_{kind}"
-                setattr(self, attr,
-                        kwargs.get(attr, config.get(f"comm.tls.{role}.{kind}")))
-        self.extra_conn_args = kwargs.get("extra_conn_args", {})
+                setattr(self, attr, opt(attr, f"comm.tls.{role}.{kind}"))
+        self.extra_conn_args = kwargs.get("extra_conn_args") or {}
 
     # ------------------------------------------------------------------
 

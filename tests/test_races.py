@@ -475,3 +475,88 @@ async def test_removal_reschedule_with_dependent_chain(c, s, a, b):
     # everything recomputes on b, including the chain ck1 -> ck2
     assert await asyncio.wait_for(f4.result(), 30) == 15
     assert await c.submit(lambda v: v + 1, f3, key="ck4").result() == 5
+
+
+@gen_cluster(nthreads=[1, 1, 1])
+async def test_amm_drop_races_with_new_dependent(c, s, a, b, d):
+    """ReduceReplicas drops a replica while a NEW dependent is being
+    placed on the dropping worker: the placement must not crash and the
+    dependent must still compute (replica re-fetched if needed)."""
+    x = c.submit(inc, 1, key="amm-x", workers=[a.address])
+    await x.result()
+    # replicate to all three workers
+    await s.replicate(keys=["amm-x"])
+    await wait_for(lambda: len(s.state.tasks["amm-x"].who_has) == 3)
+    # AMM wants the extras dropped; meanwhile dependents land everywhere
+    futs = [
+        c.submit(add, x, i, key=f"amm-child-{i}", workers=[w.address])
+        for i, w in enumerate((a, b, d))
+    ]
+    amm = s.extensions["amm"]
+    amm.run_once()
+    assert await asyncio.wait_for(c.gather(futs), 30) == [2, 3, 4]
+    s.state.validate_state()
+
+
+@gen_cluster(nthreads=[1, 1])
+async def test_retire_worker_during_steal_confirm(c, s, a, b):
+    """Retiring the thief mid steal-confirm must not lose the task."""
+    from distributed_tpu.worker.state_machine import StealRequestEvent  # noqa: F401
+
+    fut = c.submit(slowinc, 1, delay=0.4, key="rsc-x", workers=[a.address],
+                   allow_other_workers=True)
+    await wait_for(lambda: "rsc-x" in s.state.tasks
+                   and s.state.tasks["rsc-x"].state == "processing")
+    stealing = s.extensions["stealing"]
+    ts = s.state.tasks["rsc-x"]
+    # request a steal onto b, then immediately retire b
+    victim = s.state.workers[a.address]
+    thief = s.state.workers[b.address]
+    stealing.move_task_request(ts, victim, thief)
+    await s.retire_workers(workers=[b.address])
+    assert await asyncio.wait_for(fut.result(), 30) == 2
+    s.state.validate_state()
+
+
+@gen_cluster(nthreads=[1, 1], worker_cls=[None, BlockedGetData])
+async def test_client_releases_keys_while_fetch_blocked(c, s, a, b):
+    """Releasing the only consumer while its dep fetch is stuck inside
+    the peer's get_data: everything unwinds without phantom state."""
+    x = c.submit(inc, 1, key="rel-x", workers=[b.address])
+    # completion via the report stream, NOT x.result(): the result fetch
+    # itself would block on b's wedged get_data
+    await wait_for(lambda: "rel-x" in s.state.tasks
+                   and s.state.tasks["rel-x"].state == "memory")
+    y = c.submit(add, x, 1, key="rel-y", workers=[a.address])
+    await b.in_get_data.wait()
+    y.release()
+    await wait_for(lambda: "rel-y" not in s.state.tasks)
+    b.block_get_data.set()
+    # the cluster stays healthy; x is still computable data
+    assert await c.submit(add, x, 5, key="rel-z").result() == 7
+    s.state.validate_state()
+    a.state.validate_state()
+
+
+@gen_cluster(nthreads=[1, 1])
+async def test_scatter_data_survives_holder_retirement(c, s, a, b):
+    """Scattered (lineage-free) data must be replicated away when its
+    holder retires, not lost (reference retire_workers semantics)."""
+    [x] = await c.scatter([123], workers=[a.address])
+    await s.retire_workers(workers=[a.address])
+    assert a.address not in s.state.workers
+    # data survived onto b and is usable
+    assert await c.submit(inc, x, key="sc-y").result() == 124
+
+
+@gen_cluster(nthreads=[1, 1], config_overrides={"scheduler.work-stealing": False})
+async def test_resubmit_same_key_different_spec_while_erred(c, s, a, b):
+    """Resubmitting a key whose previous incarnation erred replaces the
+    spec and computes cleanly (cancelled/erred resubmission contract)."""
+    bad = c.submit(lambda: 1 // 0, key="respec-k", pure=False)
+    with pytest.raises(ZeroDivisionError):
+        await bad.result()
+    bad.release()
+    await wait_for(lambda: "respec-k" not in s.state.tasks)
+    good = c.submit(inc, 41, key="respec-k", pure=False)
+    assert await asyncio.wait_for(good.result(), 30) == 42

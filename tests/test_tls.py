@@ -87,115 +87,96 @@ async def test_tls_plaintext_connect_fails():
             pass
 
 
+# --------------------------------------------------------- CLI harness
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _spawn_cli(argv, marker, env, cwd, timeout=15):
+    """Spawn a dtpu CLI process, yield the address after its marker line;
+    SIGTERM + escalate on exit (shared by the TLS CLI tests)."""
+    import signal
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=cwd,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith(marker), line
+        yield line.split()[-1]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _cli_env():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}, repo
+
+
 @pytest.mark.slow
 def test_tls_cli_cluster_roundtrip():
     """dtpu-scheduler/dtpu-worker --tls-* flags: a real TLS cluster from
     the CLIs, driven by a TLS client (reference dask-scheduler
     --tls-cert/--tls-key/--tls-ca-file)."""
-    import os
-    import signal
-    import subprocess
-    import sys
-
     sec = Security.temporary()
-    ca = sec.tls_ca_file
-    cert = sec.tls_scheduler_cert
-    key = sec.tls_scheduler_key
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
-    tls = ["--tls-ca-file", ca, "--tls-cert", cert, "--tls-key", key]
+    env, repo = _cli_env()
+    tls = ["--tls-ca-file", sec.tls_ca_file,
+           "--tls-cert", sec.tls_scheduler_cert,
+           "--tls-key", sec.tls_scheduler_key]
 
-    sched = subprocess.Popen(
-        [sys.executable, "-m", "distributed_tpu.cli.scheduler",
-         "--port", "0", "--protocol", "tls", *tls],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        env=env, cwd=repo,
-    )
-    worker = None
-    try:
-        line = sched.stdout.readline()
-        assert line.startswith("Scheduler at: tls://"), line
-        address = line.split()[-1]
-        worker = subprocess.Popen(
-            [sys.executable, "-m", "distributed_tpu.cli.worker", address,
-             "--nthreads", "1", *tls],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            env=env, cwd=repo,
-        )
-        wline = worker.stdout.readline()
-        assert wline.startswith("Worker at: tls://"), wline
+    with _spawn_cli(
+        ["distributed_tpu.cli.scheduler", "--port", "0",
+         "--protocol", "tls", *tls],
+        "Scheduler at: tls://", env, repo,
+    ) as address:
+        with _spawn_cli(
+            ["distributed_tpu.cli.worker", address, "--nthreads", "1", *tls],
+            "Worker at: tls://", env, repo,
+        ):
+            async def drive():
+                async with Client(address, security=sec) as c:
+                    return await asyncio.wait_for(
+                        c.submit(lambda x: x * 6, 7).result(), 30
+                    )
 
-        async def drive():
-            async with Client(address, security=sec) as c:
-                return await asyncio.wait_for(
-                    c.submit(lambda x: x * 6, 7).result(), 30
-                )
-
-        assert asyncio.run(drive()) == 42
-    finally:
-        for proc in (worker, sched):
-            if proc is not None:
-                proc.send_signal(signal.SIGTERM)
-        for proc in (worker, sched):
-            if proc is not None:
-                try:
-                    proc.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
+            assert asyncio.run(drive()) == 42
 
 
 @pytest.mark.slow
 def test_tls_cli_nanny_cluster():
     """--nanny under TLS: the nanny's scheduler rpc, its control channel,
-    and the spawned worker all ride tls://."""
-    import os
-    import signal
-    import subprocess
-    import sys
-
+    and the spawned worker all ride tls://; certs without --protocol must
+    INFER tls, never silently listen in plaintext."""
     sec = Security.temporary()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+    env, repo = _cli_env()
     tls = ["--tls-ca-file", sec.tls_ca_file,
            "--tls-cert", sec.tls_scheduler_cert,
            "--tls-key", sec.tls_scheduler_key]
 
-    # certs without --protocol: the scheduler must INFER tls, never
-    # silently listen in plaintext
-    sched = subprocess.Popen(
-        [sys.executable, "-m", "distributed_tpu.cli.scheduler",
-         "--port", "0", *tls],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        env=env, cwd=repo,
-    )
-    worker = None
-    try:
-        line = sched.stdout.readline()
-        assert line.startswith("Scheduler at: tls://"), line
-        address = line.split()[-1]
-        worker = subprocess.Popen(
-            [sys.executable, "-m", "distributed_tpu.cli.worker", address,
+    with _spawn_cli(
+        ["distributed_tpu.cli.scheduler", "--port", "0", *tls],
+        "Scheduler at: tls://", env, repo,
+    ) as address:
+        with _spawn_cli(
+            ["distributed_tpu.cli.worker", address,
              "--nthreads", "1", "--nanny", *tls],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            env=env, cwd=repo,
-        )
-        wline = worker.stdout.readline()
-        assert wline.startswith("Worker at: tls://"), wline
+            "Worker at: tls://", env, repo,
+        ):
+            async def drive():
+                async with Client(address, security=sec) as c:
+                    return await asyncio.wait_for(
+                        c.submit(lambda x: x + 30, 12).result(), 60
+                    )
 
-        async def drive():
-            async with Client(address, security=sec) as c:
-                return await asyncio.wait_for(
-                    c.submit(lambda x: x + 30, 12).result(), 60
-                )
-
-        assert asyncio.run(drive()) == 42
-    finally:
-        for proc in (worker, sched):
-            if proc is not None:
-                proc.send_signal(signal.SIGTERM)
-        for proc in (worker, sched):
-            if proc is not None:
-                try:
-                    proc.wait(timeout=15)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
+            assert asyncio.run(drive()) == 42
