@@ -563,3 +563,32 @@ def test_secede_of_cancelled_task_frees_slot(ws):
     # eventual completion of the cancelled body is still clean
     finish_exec(ws, "c0")
     ws.validate_state()
+
+
+def test_long_running_task_error_and_steal_refusal(ws):
+    """A seceded (long-running) task still errs cleanly, and a steal
+    request for it is refused like an executing task."""
+    from distributed_tpu.worker.state_machine import LongRunningEvent
+
+    ws.handle_stimulus(ComputeTaskEvent.dummy("lr1", priority=(0,)))
+    ws.handle_stimulus(
+        LongRunningEvent(stimulus_id="s-sec", key="lr1", compute_duration=0.0)
+    )
+    assert ws.tasks["lr1"].state == "long-running"
+    # a steal request must be refused: the body is running
+    instrs = ws.handle_stimulus(
+        StealRequestEvent(stimulus_id="s-steal", key="lr1")
+    )
+    responses = [i for i in instrs if isinstance(i, StealResponseMsg)]
+    assert responses and responses[0].state in ("long-running", "executing")
+    assert ws.tasks["lr1"].state == "long-running"
+    # and an eventual failure still routes to error
+    instrs = ws.handle_stimulus(
+        ExecuteFailureEvent(
+            stimulus_id="s-err", key="lr1", exception=RuntimeError("boom"),
+            exception_text="boom",
+        )
+    )
+    assert any(isinstance(i, TaskErredMsg) for i in instrs)
+    assert ws.tasks["lr1"].state == "error"
+    ws.validate_state()
