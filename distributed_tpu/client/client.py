@@ -788,6 +788,15 @@ class Client:
         keys = [f.key for f in futures] if futures is not None else None
         return await self.scheduler.rebalance(keys=keys, workers=workers)
 
+    async def replicate(self, futures: Iterable[Future], n: int | None = None,
+                        workers: list[str] | None = None) -> None:
+        """Copy futures' data onto additional workers
+        (reference client.py:3732)."""
+        assert self.scheduler is not None
+        await self.scheduler.replicate(
+            keys=[f.key for f in futures], n=n, workers=workers
+        )
+
     async def register_plugin(self, plugin: Any, name: str | None = None) -> Any:
         """Install a Scheduler/Worker/Nanny plugin cluster-wide
         (reference client.py register_plugin)."""

@@ -133,6 +133,7 @@ class Scheduler(Server):
             "adaptive_target": self.adaptive_target,
             "remove_worker": self.remove_worker_handler,
             "rebalance": self.rebalance,
+            "replicate": self.replicate,
             "register_scheduler_plugin": self.register_scheduler_plugin,
             "unregister_scheduler_plugin": self.unregister_scheduler_plugin,
             "register_worker_plugin": self.register_worker_plugin,
@@ -833,13 +834,20 @@ class Scheduler(Server):
     async def replicate(self, keys: Iterable[Key] = (), n: int | None = None,
                         workers: list[str] | None = None, **kwargs: Any) -> None:
         """Copy keys onto additional workers (reference scheduler.py:6854)."""
+        if workers and not any(w in self.state.workers for w in workers):
+            # every requested target is unknown: error, don't silently
+            # fan the data out to the whole cluster instead
+            raise ValueError(
+                f"replicate: none of the requested workers are known: "
+                f"{sorted(workers)}"
+            )
         candidates = [
             self.state.workers[w] for w in (workers or [])
             if w in self.state.workers
         ] or list(self.state.running)
         if not candidates:
             return
-        n = n or len(candidates)
+        n = len(candidates) if n is None else n  # explicit 0 = no-op
         stimulus_id = seq_name("replicate")
         for key in keys:
             ts = self.state.tasks.get(key)

@@ -99,3 +99,4 @@ def test_heapset_readd_reorders_both_directions():
     assert [e.name for e in h.peekn(2)] == ["b", "a"]
     assert h.pop() is b
     assert h.pop() is a
+

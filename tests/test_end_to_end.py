@@ -15,6 +15,8 @@ import pytest
 from distributed_tpu.client.client import Client, as_completed, wait
 from distributed_tpu.deploy.local import LocalCluster
 from distributed_tpu.exceptions import KilledWorker
+from distributed_tpu.scheduler.server import Scheduler
+from distributed_tpu.worker.server import Worker
 
 from conftest import gen_test
 
@@ -302,3 +304,26 @@ async def test_scheduler_validate_invariants():
             futs = c.map(inc, range(20), pure=False)
             await c.gather(futs)
             cluster.scheduler.state.validate_state()
+
+
+@gen_test()
+async def test_client_replicate_api():
+    """client.replicate copies data to more workers (docs/quickstart);
+    unknown targets error instead of fanning out cluster-wide; n=0 is a
+    no-op."""
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=1, name="a"):
+            async with Worker(s.address, nthreads=1, name="b"):
+                async with Client(s.address) as c:
+                    fut = c.submit(lambda: 7, key="rep-k")
+                    assert await fut.result() == 7
+                    await c.replicate([fut], n=0)  # explicit no-op
+                    assert len(s.state.tasks["rep-k"].who_has) == 1
+                    await c.replicate([fut], n=2)
+                    for _ in range(200):
+                        if len(s.state.tasks["rep-k"].who_has) == 2:
+                            break
+                        await asyncio.sleep(0.01)
+                    assert len(s.state.tasks["rep-k"].who_has) == 2
+                    with pytest.raises(Exception, match="none of the"):
+                        await c.replicate([fut], workers=["tcp://nope:1"])
