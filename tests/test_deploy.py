@@ -184,3 +184,55 @@ def test_cli_version():
     )
     assert out.returncode == 0
     assert out.stdout.strip()
+
+
+@pytest.mark.slow
+def test_cli_spec_spawns_worker_from_json():
+    """dtpu-spec: run a Worker from a JSON spec against a live scheduler
+    (reference cli/dask_spec.py)."""
+    import json
+
+    sched = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=CLI_ENV, cwd=REPO,
+    )
+    worker = None
+    try:
+        line = sched.stdout.readline()
+        assert line.startswith("Scheduler at:"), line
+        address = line.split()[-1]
+        spec = json.dumps({
+            "cls": "distributed_tpu.worker.server.Worker",
+            "opts": {"nthreads": 2, "name": "spec-w"},
+        })
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tpu.cli.spec",
+             "--spec", spec, address],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=CLI_ENV, cwd=REPO,
+        )
+        wline = worker.stdout.readline()
+        assert wline.startswith("Server at:"), wline
+
+        async def drive():
+            async with Client(address) as c:
+                info = await c.scheduler_info()
+                assert any(
+                    w.get("name") == "spec-w" for w in info["workers"].values()
+                )
+                return await asyncio.wait_for(
+                    c.submit(lambda x: x - 4, 46).result(), 30
+                )
+
+        assert asyncio.run(drive()) == 42
+    finally:
+        for proc in (worker, sched):
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (worker, sched):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
