@@ -236,3 +236,45 @@ def test_cli_spec_spawns_worker_from_json():
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+@pytest.mark.slow
+def test_sync_client_facade():
+    """Client(asynchronous=False): the blocking facade drives submit/
+    map/scatter/gather from a plain script with no event loop
+    (reference SyncMethodMixin semantics)."""
+    sched = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tpu.cli.scheduler", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=CLI_ENV, cwd=REPO,
+    )
+    worker = None
+    try:
+        line = sched.stdout.readline()
+        assert line.startswith("Scheduler at:"), line
+        address = line.split()[-1]
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tpu.cli.worker", address,
+             "--nthreads", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=CLI_ENV, cwd=REPO,
+        )
+        assert worker.stdout.readline().startswith("Worker at:")
+
+        with Client(address, asynchronous=False) as c:
+            fut = c.submit(lambda x: x * 2, 21)
+            assert c.result_sync(fut) == 42
+            futs = c.map(lambda x: x + 1, range(10))
+            assert c.gather_sync(futs) == list(range(1, 11))
+            [x] = c.scatter_sync([5])
+            assert c.result_sync(c.submit(lambda v: v + 1, x)) == 6
+    finally:
+        for proc in (worker, sched):
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (worker, sched):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
