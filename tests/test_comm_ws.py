@@ -62,3 +62,26 @@ async def test_cluster_over_ws():
             assert await asyncio.wait_for(c.gather(futs), 60) == [
                 3 * i for i in range(10)
             ]
+
+
+@gen_test(timeout=90)
+async def test_ws_cluster_roundtrip():
+    """A full cluster over ws:// — scheduler, workers, client, and the
+    worker->worker data plane all ride websocket framing."""
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+
+    async with LocalCluster(
+        n_workers=2, protocol="ws",
+        scheduler_kwargs={"validate": True},
+        worker_kwargs={"validate": True},
+    ) as cluster:
+        assert cluster.scheduler_address.startswith("ws://")
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 1, range(8))
+            assert await c.gather(futs) == list(range(1, 9))
+            # cross-worker dependency over ws
+            w0, w1 = [w.address for w in cluster.workers]
+            a = c.submit(lambda: 10, key="ws-a", workers=[w0])
+            b = c.submit(lambda x: x + 5, a, key="ws-b", workers=[w1])
+            assert await b.result() == 15
