@@ -1448,11 +1448,17 @@ class SchedulerState:
             }
             s = hosts if s is None else s & hosts
         if ts.resource_restrictions:
+            # filter by total SUPPLY, not currently-free amount (reference
+            # scheduler.py:3043 checks self.resources supply): the worker
+            # state machine serializes execution against its available
+            # resources, so oversubscribed processing just queues there.
+            # Filtering by free amount sends later tasks to "no-worker"
+            # with nothing to ever wake them once the resource frees.
             res_ok = {
                 ws
                 for ws in self.workers.values()
                 if all(
-                    ws.resources.get(r, 0) - ws.used_resources.get(r, 0) >= q
+                    ws.resources.get(r, 0) >= q
                     for r, q in ts.resource_restrictions.items()
                 )
             }

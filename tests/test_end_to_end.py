@@ -327,3 +327,47 @@ async def test_client_replicate_api():
                     assert len(s.state.tasks["rep-k"].who_has) == 2
                     with pytest.raises(Exception, match="none of the"):
                         await c.replicate([fut], workers=["tcp://nope:1"])
+
+
+@gen_test()
+async def test_abstract_resources_constrain_placement():
+    """resources={'GPU': 1}: tasks run only on workers advertising the
+    resource, and the worker runs them one at a time (the scheduler
+    filters by SUPPLY and the worker serializes against availability —
+    reference test_resources.py)."""
+    import multiprocessing
+    import time as _t
+
+    peak = multiprocessing.Value("i", 0)
+    cur = multiprocessing.Value("i", 0)
+
+    def gpu_task(x):
+        with cur.get_lock():
+            cur.value += 1
+            peak.value = max(peak.value, cur.value)
+        _t.sleep(0.05)
+        with cur.get_lock():
+            cur.value -= 1
+        return x * 2
+
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=2, validate=True,
+                          name="plain") as plain:  # noqa: F841
+            async with Worker(s.address, nthreads=2, validate=True,
+                              name="gpu", resources={"GPU": 1}) as gpu:
+                async with Client(s.address) as c:
+                    futs = c.map(
+                        gpu_task, range(6),
+                        pure=False, resources={"GPU": 1},
+                    )
+                    assert await asyncio.wait_for(c.gather(futs), 30) == [
+                        x * 2 for x in range(6)
+                    ]
+                    # every one ran on the GPU worker
+                    who = await c.who_has(futs)
+                    assert all(
+                        holders == [gpu.address]
+                        for holders in who.values()
+                    ), who
+                    # GPU:1 on an nthreads=2 worker: never 2 at once
+                    assert peak.value == 1, peak.value
