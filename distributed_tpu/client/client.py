@@ -735,9 +735,9 @@ class Client:
 
     async def run(self, fn: Callable, *args: Any,
                   workers: list[str] | None = None, wait: bool = True,
-                  **kwargs: Any) -> dict:
-        """Run a function on workers outside the task system
-        (reference client.py:2904)."""
+                  nanny: bool = False, **kwargs: Any) -> dict:
+        """Run a function on workers (or their nannies with nanny=True)
+        outside the task system (reference client.py:2904)."""
         assert self.scheduler is not None
         resp = await self.scheduler.broadcast(
             msg={
@@ -748,6 +748,7 @@ class Client:
                 "wait": wait,
             },
             workers=workers,
+            nanny=nanny,
         )
         out = {}
         for addr, r in resp.items():
@@ -813,7 +814,9 @@ class Client:
                 plugin=Serialize(plugin), name=name
             )
         if isinstance(plugin, NannyPlugin):
-            raise NotImplementedError("nanny plugins register via Nanny kwargs")
+            return await self.scheduler.register_nanny_plugin(
+                plugin=Serialize(plugin), name=name
+            )
         # default: worker plugin (reference treats unknown as worker plugin)
         return await self.scheduler.register_worker_plugin(
             plugin=Serialize(plugin), name=name

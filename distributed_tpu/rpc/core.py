@@ -456,6 +456,34 @@ class RPCCall:
         return send_recv_op
 
 
+async def run_user_function(server: Any, inject_kw: str, function: Any = None,
+                            args: Any = None, kwargs: Any = None,
+                            wait: bool = True) -> Any:
+    """Shared body of the run-arbitrary-function handlers on scheduler,
+    worker, and nanny (reference run handlers): unwrap, optionally inject
+    the hosting server under ``inject_kw``, await coroutines, wrap errors."""
+    import inspect
+
+    from distributed_tpu.protocol.serialize import Serialize, unwrap
+
+    fn = unwrap(function)
+    a = unwrap(args) or ()
+    kw = unwrap(kwargs) or {}
+    try:
+        if inject_kw in inspect.signature(fn).parameters:
+            kw[inject_kw] = server
+        result = fn(*a, **kw)
+        if asyncio.iscoroutine(result):
+            if wait:
+                result = await result
+            else:
+                server._ongoing_background_tasks.call_soon(lambda: result)
+                result = None
+        return {"status": "OK", "result": Serialize(result)}
+    except Exception as e:
+        return error_message(e)
+
+
 async def send_recv(comm: Comm, *, op: str, reply: bool = True, **kwargs: Any) -> Any:
     await comm.write({"op": op, "reply": reply, **kwargs})
     if not reply:

@@ -137,6 +137,8 @@ class Scheduler(Server):
             "register_scheduler_plugin": self.register_scheduler_plugin,
             "unregister_scheduler_plugin": self.unregister_scheduler_plugin,
             "register_worker_plugin": self.register_worker_plugin,
+            "register_nanny_plugin": self.register_nanny_plugin,
+            "unregister_nanny_plugin": self.unregister_nanny_plugin,
             "unregister_worker_plugin": self.unregister_worker_plugin,
             "get_cluster_state": self.get_cluster_state,
             "get_runspec": self.get_runspec,
@@ -189,6 +191,7 @@ class Scheduler(Server):
         self._topic_subscribers: dict[str, set[str]] = {}
         self.state.events_subscriber_hook = self._fan_out_event
         self.worker_plugins: dict[str, Any] = {}  # shipped to joining workers
+        self._nanny_plugins: dict[str, Any] = {}  # shipped to joining nannies
         self.handlers["get_task_stream"] = self.get_task_stream
         from distributed_tpu.diagnostics.memory_sampler import (
             memory_sample_handler,
@@ -352,6 +355,13 @@ class Scheduler(Server):
         )
         if kwargs.get("versions"):
             ws.extra["versions"] = kwargs["versions"]
+        if kwargs.get("nanny"):
+            ws.extra["nanny"] = kwargs["nanny"]
+            # late-joining nanny gets the already-registered nanny plugins
+            for pname, pblob in self._nanny_plugins.items():
+                self._ongoing_background_tasks.call_soon(
+                    self._push_nanny_plugin, kwargs["nanny"], pname, pblob
+                )
         self._last_worker_seen[address] = time()
         logger.info("register worker %s (%d threads)", address, ws.nthreads)
 
@@ -920,6 +930,14 @@ class Scheduler(Server):
         """Send an RPC to many workers, gather replies (reference :6331)."""
         msg = dict(unwrap(msg) or {})
         targets = workers if workers is not None else list(self.state.workers)
+        if nanny:
+            # route to the workers' nannies (reference scheduler.py:6331)
+            targets = [
+                ws.extra["nanny"]
+                for a in targets
+                if (ws := self.state.workers.get(a)) is not None
+                and ws.extra.get("nanny")
+            ]
         op = msg.pop("op")
 
         async def one(addr: str):
@@ -934,20 +952,11 @@ class Scheduler(Server):
     async def run_function_on_scheduler(self, function: Any = None,
                                         args: Any = None,
                                         kwargs: Any = None, **kw: Any) -> Any:
-        fn = unwrap(function)
-        a = unwrap(args) or ()
-        k = unwrap(kwargs) or {}
-        try:
-            import inspect
+        from distributed_tpu.rpc.core import run_user_function
 
-            if "dtpu_scheduler" in inspect.signature(fn).parameters:
-                k["dtpu_scheduler"] = self
-            result = fn(*a, **k)
-            if asyncio.iscoroutine(result):
-                result = await result
-            return {"status": "OK", "result": Serialize(result)}
-        except Exception as e:
-            return error_message(e)
+        return await run_user_function(
+            self, "dtpu_scheduler", function, args, kwargs, True
+        )
 
     def adaptive_target(self, target_duration: float = 5.0) -> int:
         """Desired worker count to drain current load in ``target_duration``
@@ -1105,6 +1114,35 @@ class Scheduler(Server):
             msg={"op": "plugin_add", "plugin": plugin, "name": name}
         )
         return out
+
+    async def register_nanny_plugin(self, plugin: Any = None,
+                                    name: str | None = None) -> dict:
+        """Install a NannyPlugin on every current and future nanny
+        (reference scheduler.py register_nanny_plugin)."""
+        if name is None:
+            name = f"nanny-plugin-{seq_name('np')}"
+        plugin = wrap_opaque(plugin)
+        self._nanny_plugins[name] = plugin
+        return await self.broadcast(
+            msg={"op": "plugin_add", "plugin": plugin, "name": name},
+            nanny=True,
+        )
+
+    async def unregister_nanny_plugin(self, name: str = "") -> dict:
+        self._nanny_plugins.pop(name, None)
+        return await self.broadcast(
+            msg={"op": "plugin_remove", "name": name}, nanny=True
+        )
+
+    async def _push_nanny_plugin(self, nanny_addr: str, name: str,
+                                 plugin: Any) -> None:
+        try:
+            await self.rpc(nanny_addr).plugin_add(plugin=plugin, name=name)
+        except Exception:
+            logger.warning(
+                "could not ship nanny plugin %r to %s", name, nanny_addr,
+                exc_info=True,
+            )
 
     async def unregister_worker_plugin(self, name: str = "") -> dict:
         self.worker_plugins.pop(name, None)

@@ -332,6 +332,8 @@ class WorkerState:
         self.long_running: set[TaskState] = set()
         self.executing: dict[TaskState, float] = {}
         self.resources: dict[str, float] = {}
+        # diagnostics-only: placement filters by SUPPLY (valid_workers);
+        # actual execution concurrency is constrained worker-side
         self.used_resources: dict[str, float] = {}
         self.occupancy = 0.0
         self._network_occ = 0  # bytes pending transfer to this worker

@@ -102,7 +102,11 @@ class Nanny(Server):
             "restart": self.restart_rpc,
             "terminate": self.close_rpc,
             "worker_address": self.get_worker_address,
+            "run": self.run_function,
+            "plugin_add": self.plugin_add,
+            "plugin_remove": self.plugin_remove,
         }
+        self.plugins: dict[str, Any] = {}
         super().__init__(handlers=handlers, name=name, **server_kwargs)
 
     # ------------------------------------------------------------ lifecycle
@@ -196,6 +200,7 @@ class Nanny(Server):
         # the NANNY owns the lifetime (it can restart); zero the child's
         # own config-read timer or both would fire independently
         kwargs.setdefault("lifetime", 0)
+        kwargs.setdefault("nanny_addr", self.address)
         if self.security is not None:
             kwargs.setdefault("security", self.security)
         env = dict(config.get("nanny.pre-spawn-environ") or {})
@@ -327,6 +332,57 @@ class Nanny(Server):
     async def close_rpc(self, reason: str = "") -> str:
         self._ongoing_background_tasks.call_soon(self.close)
         return "OK"
+
+    async def run_function(self, function: Any = None, args: Any = None,
+                           kwargs: Any = None, wait: bool = True) -> Any:
+        """Run an arbitrary function on this nanny (client.run(nanny=True),
+        reference nanny run handler)."""
+        from distributed_tpu.rpc.core import run_user_function
+
+        return await run_user_function(
+            self, "dtpu_nanny", function, args, kwargs, wait
+        )
+
+    async def plugin_add(self, plugin: Any = None, name: str = "") -> dict:
+        """Install a NannyPlugin (reference nanny.py plugin_add):
+        idempotent per name (the scheduler re-pushes its plugin set on
+        every worker registration), and honors ``plugin.restart`` by
+        cycling the worker process so the change reaches the child."""
+        from distributed_tpu.protocol.serialize import unwrap
+        from distributed_tpu.rpc.core import error_message
+
+        plugin = unwrap(plugin)
+        name = name or getattr(plugin, "name", type(plugin).__name__)
+        if name in self.plugins:
+            return {"status": "OK"}
+        self.plugins[name] = plugin
+        try:
+            setup = getattr(plugin, "setup", None)
+            if setup is not None:
+                res = setup(self)
+                if asyncio.iscoroutine(res):
+                    await res
+            if getattr(plugin, "restart", False):
+                await self.kill(graceful=True)
+                await self.instantiate()
+        except Exception as e:
+            return error_message(e)
+        return {"status": "OK"}
+
+    async def plugin_remove(self, name: str = "") -> dict:
+        """Uninstall a NannyPlugin (teardown hook honored)."""
+        from distributed_tpu.rpc.core import error_message
+
+        plugin = self.plugins.pop(name, None)
+        try:
+            teardown = getattr(plugin, "teardown", None)
+            if teardown is not None:
+                res = teardown(self)
+                if asyncio.iscoroutine(res):
+                    await res
+        except Exception as e:
+            return error_message(e)
+        return {"status": "OK"}
 
     async def get_worker_address(self) -> str | None:
         return self.worker_address

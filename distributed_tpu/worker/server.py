@@ -81,8 +81,10 @@ class Worker(Server):
         security: Any | None = None,
         lifetime: float | None = None,
         lifetime_stagger: float | None = None,
+        nanny_addr: str | None = None,
         **server_kwargs: Any,
     ):
+        self.nanny_addr = nanny_addr
         self._http_port = http_port
         self.http_server = None
         self.monitor = None
@@ -269,6 +271,7 @@ class Worker(Server):
                 "op": "register-worker",
                 "address": self.address,
                 "nthreads": self.nthreads,
+                "nanny": self.nanny_addr,
                 "name": self.name,
                 "memory_limit": self.memory_limit,
                 "resources": self.state.total_resources,
@@ -412,24 +415,11 @@ class Worker(Server):
         wait: bool = True,
     ) -> Any:
         """Run an arbitrary function on this worker (reference worker.py run)."""
-        fn = unwrap(function)
-        args = unwrap(args) or ()
-        kw = unwrap(kwargs) or {}
-        try:
-            import inspect
+        from distributed_tpu.rpc.core import run_user_function
 
-            if "dtpu_worker" in inspect.signature(fn).parameters:
-                kw["dtpu_worker"] = self
-            result = fn(*args, **kw)
-            if asyncio.iscoroutine(result):
-                if wait:
-                    result = await result
-                else:
-                    self._ongoing_background_tasks.call_soon(lambda: result)
-                    result = None
-            return {"status": "OK", "result": Serialize(result)}
-        except Exception as e:
-            return error_message(e)
+        return await run_user_function(
+            self, "dtpu_worker", function, args, kwargs, wait
+        )
 
     async def update_data_handler(self, data: Any = None, report: bool = True) -> dict:
         """Receive scattered data (reference worker.py update_data)."""

@@ -128,3 +128,47 @@ async def test_nanny_lifetime_restart_cycles_worker():
             assert nanny.worker_address in s.state.workers
         finally:
             await nanny.close()
+
+
+@pytest.mark.slow
+@gen_test(timeout=180)
+async def test_run_on_nanny_and_nanny_plugin():
+    """client.run(nanny=True) executes on the nanny process, and a
+    NannyPlugin registered through the client reaches current AND
+    late-joining nannies (reference test_nanny.py patterns)."""
+    from distributed_tpu.diagnostics.plugin import NannyPlugin
+
+    class Tag(NannyPlugin):
+        name = "tagger"
+
+        def setup(self, nanny):
+            nanny.tagged = True
+
+    async with Scheduler(listen_addr="tcp://127.0.0.1:0", validate=True) as s:
+        nanny = Nanny(s.address, nthreads=1)
+        await nanny.start()
+        try:
+            async with Client(s.address) as c:
+                # the worker reported its nanny address
+                ws = s.state.workers[nanny.worker_address]
+                assert ws.extra.get("nanny") == nanny.address
+                # run on the NANNY, not the worker
+                out = await c.run(lambda dtpu_nanny=None: type(dtpu_nanny).__name__,
+                                  nanny=True)
+                assert out == {nanny.address: "Nanny"}
+                # plugin reaches the live nanny
+                await c.register_plugin(Tag())
+                assert getattr(nanny, "tagged", False)
+                # ...and a late-joining nanny
+                n2 = Nanny(s.address, nthreads=1)
+                await n2.start()
+                try:
+                    for _ in range(100):
+                        if getattr(n2, "tagged", False):
+                            break
+                        await asyncio.sleep(0.1)
+                    assert getattr(n2, "tagged", False)
+                finally:
+                    await n2.close()
+        finally:
+            await nanny.close()
