@@ -371,3 +371,28 @@ async def test_abstract_resources_constrain_placement():
                     ), who
                     # GPU:1 on an nthreads=2 worker: never 2 at once
                     assert peak.value == 1, peak.value
+
+
+@gen_test()
+async def test_reschedule_exception_reruns_task():
+    """A task raising Reschedule goes back to the scheduler and reruns
+    to completion (reference test_reschedule; exceptions.Reschedule is
+    public API)."""
+    import multiprocessing
+
+    from distributed_tpu.exceptions import Reschedule
+
+    attempts = multiprocessing.Value("i", 0)
+
+    def flaky():
+        with attempts.get_lock():
+            attempts.value += 1
+            if attempts.value == 1:
+                raise Reschedule("try me again")
+        return 42
+
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(flaky, pure=False)
+            assert await asyncio.wait_for(fut.result(), 30) == 42
+            assert attempts.value >= 2
