@@ -104,6 +104,8 @@ defaults: dict[str, Any] = {
         "memory-limit": "128MiB",        # backpressure threshold for buffered shards
         "comm-message-bytes": "2MiB",    # outbound shard batch size per peer
         "run-ttl": "300s",               # forget idle runs after this long
+        "max-restarts": 5,               # epoch restarts before the shuffle errs
+        "restart-debounce": "50ms",      # coalescing window for restart causes
     },
     "nanny": {
         "preload": [],

@@ -26,6 +26,12 @@ class KilledWorker(Exception):
         )
 
 
+class P2PShuffleError(RuntimeError):
+    """A P2P shuffle exhausted its restart budget (reference
+    shuffle/_exceptions.py P2PConsistencyError/ShuffleClosedError role):
+    raised to clients waiting on the shuffle's output tasks."""
+
+
 class CommClosedError(IOError):
     """The communication channel closed (reference comm/core.py:25)."""
 

@@ -160,6 +160,12 @@ def error_message(e: BaseException) -> dict:
 
 
 def raise_remote_error(resp: dict) -> None:
+    if "exception" not in resp:
+        # a handler replied {"status": "error", "error": "..."} without a
+        # pickled exception envelope: surface it instead of KeyError
+        from distributed_tpu.exceptions import RPCError
+
+        raise RPCError(resp.get("error", repr(resp)))
     exc = _pickle.loads(resp["exception"])
     if resp.get("traceback-text"):
         note = f"\n\nRemote traceback:\n{resp['traceback-text']}"

@@ -634,7 +634,6 @@ class SchedulerState:
             # who_has)
             if not dts.who_has:
                 ts.waiting_on.add(dts)
-                dts.waiters.add(ts)
                 if dts.state == "released":
                     recommendations[dts.key] = "waiting"
                 elif dts.state == "memory":
@@ -643,6 +642,12 @@ class SchedulerState:
                     # if a released rec is already queued in this cascade
                     # the dict merge dedupes it
                     recommendations[dts.key] = "released"
+            # register as a waiter on EVERY dependency, satisfied ones
+            # included (reference scheduler.py:2110): if an in-memory
+            # dep later loses its replicas, _transition_memory_released
+            # must find this task in dep.waiters to reschedule it — else
+            # it keeps processing against a released dependency
+            dts.waiters.add(ts)
         ts.state = "waiting"
         self._count_transition(ts, "released", "waiting")
         if not ts.waiting_on:
@@ -1076,11 +1081,17 @@ class SchedulerState:
             client_msgs.setdefault(cs.client_key, []).append(report_msg)
         if not ts.run_spec:  # pure data (scatter) — cannot be recomputed
             recommendations[key] = "forgotten"
-        elif ts.who_wants or ts.waiters:
+        elif not ts.exception_blame and (ts.who_wants or ts.waiters):
+            # exception_blame guard: a task being routed memory->erred
+            # (e.g. shuffle restart-budget exhaustion) must not be
+            # resurrected here — the composed transition would let this
+            # "waiting" override the "erred" target
             recommendations[key] = "waiting"
         if recommendations.get(key) == "waiting":
             for dts in ts.dependencies:
                 dts.waiters.add(ts)
+        else:
+            self._deregister_waiter(ts, recommendations)
         return recommendations, client_msgs, worker_msgs
 
     def _transition_released_forgotten(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
@@ -1135,6 +1146,19 @@ class SchedulerState:
             recommendations[ts.key] = "waiting"
             for dts in ts.dependencies:
                 dts.waiters.add(ts)
+        else:
+            # staying released (nobody reruns us): deregister as a waiter
+            # so finished deps can be collected — tasks register on EVERY
+            # dep at scheduling time (released->waiting), so without this
+            # a released-for-good task pins its deps in memory forever
+            self._deregister_waiter(ts, recommendations)
+
+    def _deregister_waiter(self, ts: TaskState, recommendations: dict) -> None:
+        for dts in ts.dependencies:
+            if ts in dts.waiters:
+                dts.waiters.discard(ts)
+                if not dts.waiters and not dts.who_wants:
+                    recommendations[dts.key] = "released"
 
     def _remove_from_waiting(self, ts: TaskState, recommendations: dict) -> None:
         for dts in ts.waiting_on:
@@ -2014,13 +2038,25 @@ class SchedulerState:
                 assert ts in dts.waiters, (ts, dts)
             for dts in ts.dependencies:
                 assert ts in dts.dependents, (ts, dts)
+                # the real data-safety invariant, checked from the
+                # dependent side (reference validate_task_state "dep
+                # missing"): an in-play task either still waits on the
+                # dep or the dep has a live replica
+                if ts.state in ("waiting", "queued", "processing", "no-worker"):
+                    assert dts in ts.waiting_on or dts.who_has, (
+                        "dep missing", ts, dts,
+                    )
             for dts in ts.waiters:
+                # waiters = dependents not yet finished (reference
+                # scheduler.py:2110): they may be processing against a
+                # dep that is memory now — or released mid-cascade, in
+                # which case the release has already recommended them
+                # back to waiting
                 assert dts.state in ("waiting", "queued", "processing", "no-worker"), (
                     ts,
                     dts,
                     dts.state,
                 )
-                assert ts in dts.waiting_on or ts.state == "memory", (ts, dts)
 
             if ts.state == "waiting":
                 assert not ts.who_has, ts

@@ -21,6 +21,8 @@ from __future__ import annotations
 import logging
 from typing import Any
 
+from distributed_tpu import config
+from distributed_tpu.exceptions import P2PShuffleError
 from distributed_tpu.utils.misc import seq_name
 
 logger = logging.getLogger("distributed_tpu.shuffle")
@@ -28,7 +30,7 @@ logger = logging.getLogger("distributed_tpu.shuffle")
 
 class ShuffleState:
     __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for",
-                 "participants")
+                 "participants", "attempts")
 
     def __init__(self, id: str, run_id: int, npartitions_out: int,
                  n_inputs: int, worker_for: dict[int, str]):
@@ -41,6 +43,9 @@ class ShuffleState:
         # included) — the barrier must flush ALL of them, not just output
         # owners (reference _scheduler_plugin.py:95)
         self.participants: set[str] = set()
+        # consecutive epoch restarts without a completed barrier: bounded
+        # by shuffle.max-restarts, reset on barrier success
+        self.attempts = 0
 
     @property
     def all_workers(self) -> set[str]:
@@ -62,6 +67,15 @@ class ShuffleSchedulerExtension:
     def __init__(self, scheduler: Any):
         self.scheduler = scheduler
         self.active: dict[str, ShuffleState] = {}
+        # restart coalescing: worker departures arrive one remove_worker
+        # call at a time even when a whole scale-down leaves together; a
+        # debounce window turns N departures into ONE epoch restart
+        # (reference _scheduler_plugin.py:336-344 restarts per event)
+        self._pending_restarts: dict[str, str] = {}  # id -> first reason
+        self.max_restarts = int(config.get("shuffle.max-restarts") or 0)
+        self.restart_debounce = config.parse_timedelta(
+            config.get("shuffle.restart-debounce")
+        )
         scheduler.handlers.update(
             {
                 "shuffle_get_or_create": self.handle_get_or_create,
@@ -84,10 +98,81 @@ class ShuffleSchedulerExtension:
         return {j: addrs[j % len(addrs)] for j in range(npartitions_out)}
 
     def _task_keys(self, st: ShuffleState) -> list[str]:
+        """Insertion order matters: the transition engine drains
+        recommendations LIFO (``dict.popitem``), so listing transfers
+        first and unpacks last makes DEPENDENTS transition first —
+        releasing a producer before its processing dependent would trip
+        the scheduler's dep-missing invariant mid-drain."""
         keys = [f"{st.id}-transfer-{i}" for i in range(st.n_inputs)]
         keys.append(f"{st.id}-barrier")
         keys.extend(f"{st.id}-unpack-{j}" for j in range(st.npartitions_out))
         return keys
+
+    def _closing(self) -> bool:
+        return self.scheduler.status.name in ("closing", "closed")
+
+    def _request_restart(self, st: ShuffleState, reason: str) -> None:
+        """Coalescing entry point for every restart cause (worker loss,
+        barrier failure, worker-requested): causes arriving within the
+        debounce window restart the epoch ONCE, and repeated restarts
+        back off exponentially."""
+        if self._closing():
+            return
+        if st.id in self._pending_restarts:
+            return  # already scheduled: this cause rides along
+        self._pending_restarts[st.id] = reason
+        delay = min(
+            self.restart_debounce * (2 ** min(st.attempts, 6)), 2.0
+        )
+        # per-shuffle timer: a shared drain would let shuffle B's short
+        # debounce fire shuffle A's restart early, collapsing A's backoff
+        self.scheduler._ongoing_background_tasks.call_later(
+            delay, self._drain_restart, st.id
+        )
+
+    async def _drain_restart(self, id: str) -> None:
+        reason = self._pending_restarts.pop(id, None)
+        if reason is None or self._closing():
+            return
+        st = self.active.get(id)
+        if st is None:
+            return
+        st.attempts += 1
+        if self.max_restarts and st.attempts > self.max_restarts:
+            self._fail(st, reason)
+        else:
+            self._restart(st, reason)
+
+    def _fail(self, st: ShuffleState, reason: str) -> None:
+        """Restart budget exhausted: err the shuffle's output tasks so
+        clients get a P2PShuffleError instead of an endless restart storm."""
+        logger.error(
+            "shuffle %s failed after %d restarts (%s)",
+            st.id, st.attempts - 1, reason,
+        )
+        self.active.pop(st.id, None)
+        state = self.scheduler.state
+        exc = P2PShuffleError(
+            f"shuffle {st.id} failed after {st.attempts - 1} restarts: "
+            f"{reason}"
+        )
+        recs: dict[str, str] = {}
+        for k in self._task_keys(st):
+            ts = state.tasks.get(k)
+            if ts is None or ts.state in ("erred", "forgotten"):
+                continue
+            # preset the blame so any-state -> erred composes through
+            # released (state._transition routes untable'd pairs there,
+            # and _transition_waiting_released checks exception_blame
+            # before resurrecting a wanted task)
+            ts.exception = exc
+            ts.exception_text = str(exc)
+            ts.exception_blame = ts
+            recs[k] = "erred"
+        if recs:
+            stimulus_id = seq_name("shuffle-failed")
+            client_msgs, worker_msgs = state.transitions(recs, stimulus_id)
+            self.scheduler.send_all(client_msgs, worker_msgs)
 
     def _restart(self, st: ShuffleState, reason: str) -> None:
         st.run_id += 1
@@ -179,8 +264,12 @@ class ShuffleSchedulerExtension:
             # a participant died or went stale mid-barrier: restart the
             # epoch rather than serve partial outputs
             if run_id == st.run_id:
-                self._restart(st, f"barrier failed: {failures[0]!r}")
-            return {"status": "error", "error": repr(failures[0])}
+                self._request_restart(st, f"barrier failed: {failures[0]!r}")
+            # NOT "status": "error" — that is the RPC layer's reserved
+            # pickled-exception envelope (raise_remote_error); the task
+            # body maps any non-OK status to ShuffleClosedError itself
+            return {"status": "barrier-failed", "error": repr(failures[0])}
+        st.attempts = 0  # a completed barrier proves the epoch is healthy
         return {"status": "OK", "run_id": run_id}
 
     async def handle_restart(self, id: str = "", run_id: int = 0,
@@ -191,7 +280,7 @@ class ShuffleSchedulerExtension:
         if st is None:
             return {"status": "unknown-shuffle", "id": id}
         if run_id == st.run_id:
-            self._restart(st, f"worker-requested (run {run_id})")
+            self._request_restart(st, f"worker-requested (run {run_id})")
         return {"status": "OK", "run_id": st.run_id}
 
     # ------------------------------------------------- scheduler callbacks
@@ -200,14 +289,21 @@ class ShuffleSchedulerExtension:
         """Participating worker died: every shuffle it owned outputs for
         or held transfer state for restarts under a new epoch
         (reference _scheduler_plugin.py:344)."""
-        if self.scheduler.status.name in ("closing", "closed"):
+        if self._closing():
             # cluster shutdown: workers leave one by one — restarting
             # each active shuffle per departure is noise, not recovery
             self.active.clear()
+            self._pending_restarts.clear()
             return
         for st in list(self.active.values()):
             if address in st.all_workers:
-                self._restart(st, f"lost worker {address}")
+                self._request_restart(st, f"lost worker {address}")
 
     def forget(self, id: str) -> None:
         self.active.pop(id, None)
+
+    def close(self) -> None:
+        """Scheduler shutdown: abandon active runs and pending restarts —
+        departures during close must not spawn recovery work."""
+        self.active.clear()
+        self._pending_restarts.clear()

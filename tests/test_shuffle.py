@@ -336,3 +336,118 @@ def test_join_arrays_empty_sides():
     for s in out.values():
         if 0.0 in s["key"]:
             assert (s["key"] == 0.0).sum() == 2
+
+
+# ------------------------------------------------- lifecycle hardening
+
+
+@gen_test(timeout=60)
+async def test_multi_worker_loss_coalesces_to_one_restart():
+    """Three participants leaving inside the debounce window must bump
+    the epoch ONCE, not once per departure (the restart-storm fix;
+    contrast reference _scheduler_plugin.py:336-344 which restarts per
+    event)."""
+    async with await new_cluster(n_workers=4) as cluster:
+        sched = cluster.scheduler
+        ext = sched.extensions["shuffle"]
+        resp = await ext.handle_get_or_create(
+            id="s-coalesce", npartitions_out=8, n_inputs=4
+        )
+        assert resp["status"] == "OK"
+        st = ext.active["s-coalesce"]
+        assert st.run_id == 1
+        victims = sorted(set(st.worker_for.values()))[:3]
+        assert len(victims) == 3
+        for addr in victims:
+            await sched.remove_worker(addr, reason="test-scale-down")
+        await asyncio.sleep(ext.restart_debounce * 6 + 0.05)
+        assert st.run_id == 2, "3 departures must coalesce into 1 restart"
+        # survivors own every output partition now
+        assert not set(st.worker_for.values()) & set(victims)
+
+
+@gen_test(timeout=60)
+async def test_shuffle_during_scheduler_close_aborts_cleanly():
+    """Worker departures during Scheduler.close() must not spawn epoch
+    restarts (shutdown is not recovery)."""
+    async with await new_cluster(n_workers=3) as cluster:
+        sched = cluster.scheduler
+        ext = sched.extensions["shuffle"]
+        await ext.handle_get_or_create(
+            id="s-closing", npartitions_out=4, n_inputs=2
+        )
+        st = ext.active["s-closing"]
+    # cluster context exit closed workers + scheduler
+    assert ext.active == {}
+    assert ext._pending_restarts == {}
+    assert st.run_id == 1, "no restart may fire during shutdown"
+
+
+@gen_test(timeout=90)
+async def test_shuffle_restart_budget_errs_tasks():
+    """A shuffle that keeps restarting past shuffle.max-restarts must err
+    its output tasks with P2PShuffleError instead of looping forever."""
+    from distributed_tpu import config
+    from distributed_tpu.exceptions import P2PShuffleError
+
+    def slow_partition(i):
+        import time as _t
+
+        _t.sleep(30)
+        return [i]
+
+    with config.set({"shuffle.max-restarts": 2,
+                     "shuffle.restart-debounce": "10ms"}):
+        async with await new_cluster(n_workers=2) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                ext = cluster.scheduler.extensions["shuffle"]
+                # inputs never finish, so the shuffle's tasks sit waiting
+                # while we exhaust the restart budget
+                inputs = [
+                    c.submit(slow_partition, i, key=f"slowin-{i}")
+                    for i in range(2)
+                ]
+                outs = await p2p_shuffle(c, inputs, npartitions_out=2)
+                sid = outs[0].key.rsplit("-unpack-", 1)[0]
+                st = ext.active[sid]
+                for _ in range(4):
+                    await ext.handle_restart(id=sid, run_id=st.run_id)
+                    await asyncio.sleep(0.2)
+                    if sid not in ext.active:
+                        break
+                assert sid not in ext.active, "budget exhaustion must drop it"
+                with pytest.raises(P2PShuffleError):
+                    await asyncio.wait_for(c.gather(outs), 30)
+
+
+@gen_test(timeout=90)
+async def test_restart_budget_errs_with_transfers_in_memory():
+    """Budget exhaustion while transfer tasks sit in MEMORY (the common
+    barrier-keeps-failing shape): the memory tasks must not be
+    resurrected to waiting (which would recreate a zombie shuffle) — the
+    outputs err with P2PShuffleError and the shuffle stays dropped."""
+    from distributed_tpu import config
+    from distributed_tpu.exceptions import P2PShuffleError
+
+    with config.set({"shuffle.max-restarts": 1,
+                     "shuffle.restart-debounce": "10ms"}):
+        async with await new_cluster(n_workers=2) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                sched = cluster.scheduler
+                ext = sched.extensions["shuffle"]
+                # every barrier RPC fails: transfers complete to memory,
+                # the barrier task keeps requesting restarts
+                async def failing_barrier(**kwargs):
+                    return {"status": "barrier-failed", "error": "induced"}
+
+                sched.handlers["shuffle_barrier"] = failing_barrier
+                inputs = [
+                    c.submit(make_partition, i, key=f"bin-{i}")
+                    for i in range(2)
+                ]
+                await c.gather(inputs)
+                outs = await p2p_shuffle(c, inputs, npartitions_out=2)
+                with pytest.raises(P2PShuffleError):
+                    await asyncio.wait_for(c.gather(outs), 60)
+                sid = outs[0].key.rsplit("-unpack-", 1)[0]
+                assert sid not in ext.active, "failed shuffle must stay dropped"
