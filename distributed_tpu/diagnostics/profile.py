@@ -9,6 +9,7 @@ across workers (``merge``, reference profile.py:219).  Exposed via
 
 from __future__ import annotations
 
+import logging
 import sys
 import threading
 from collections import deque
@@ -16,6 +17,8 @@ from typing import Any
 
 from distributed_tpu import config
 from distributed_tpu.utils.misc import time
+
+logger = logging.getLogger("distributed_tpu.profile")
 
 
 def create() -> dict:
@@ -79,12 +82,86 @@ def _merge_children(dst: dict, src: dict) -> None:
             _merge_children(d["children"], node["children"])
 
 
+class _SharedWatcher:
+    """One process-wide sampling thread serving every Profiler.
+
+    In-process clusters run many workers in one interpreter; a sampler
+    thread per worker multiplies GIL wakeups and ``sys._current_frames``
+    calls by the worker count.  The shared watcher takes ONE frames
+    snapshot per tick and feeds each registered profiler its own
+    threads' samples."""
+
+    def __init__(self) -> None:
+        self._profilers: set = set()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    def register(self, prof: "Profiler") -> None:
+        with self._lock:
+            self._profilers.add(prof)
+            self._wake.set()
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="dtpu-profiler", daemon=True
+                )
+                self._thread.start()
+
+    def unregister(self, prof: "Profiler") -> None:
+        with self._lock:
+            self._profilers.discard(prof)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                profs = list(self._profilers)
+            if not profs:
+                # linger briefly for a new registration, then exit
+                if self._wake.wait(0.5):
+                    self._wake.clear()
+                    continue
+                with self._lock:
+                    if not self._profilers:
+                        self._thread = None
+                        return
+                continue
+            interval = min(p.interval for p in profs)
+            if self._wake.wait(interval):  # also wakes on new registration
+                self._wake.clear()
+            now = time()
+            wanted: dict[int, list] = {}
+            for p in profs:
+                try:
+                    idents = p._due_idents(now)
+                except Exception:
+                    # a broken idents/active callback must not kill the
+                    # process-wide sampler: drop that profiler only
+                    logger.exception("profiler callback failed; dropping")
+                    self.unregister(p)
+                    continue
+                for ident in idents:
+                    wanted.setdefault(ident, []).append(p)
+            if not wanted:
+                continue
+            frames = sys._current_frames()
+            for ident, targets in wanted.items():
+                frame = frames.get(ident)
+                if frame is None:
+                    continue
+                for p in targets:
+                    p._add_sample(frame, now)
+
+
+_shared_watcher = _SharedWatcher()
+
+
 class Profiler:
-    """Background sampling thread (reference profile.py watch :371)."""
+    """Statistical profiler handle; sampling runs on the process-shared
+    watcher thread (reference profile.py watch :371)."""
 
     def __init__(self, thread_filter: str = "dtpu-worker-exec",
                  interval: float | None = None, cycle: float | None = None,
-                 maxlen: int = 60):
+                 maxlen: int = 60, idents=None, active=None):
         prof_cfg = config.get("worker.profile")
         self.interval = interval if interval is not None else config.parse_timedelta(
             prof_cfg["interval"]
@@ -93,40 +170,51 @@ class Profiler:
             prof_cfg["cycle"]
         )
         self.thread_filter = thread_filter
+        # idents: callable returning the thread idents to sample.  When
+        # given, the sampler never calls threading.enumerate() — with N
+        # in-process workers each running a profiler, enumerate+name over
+        # the whole process's threads was O(N * threads) per tick and
+        # measurably starved the (single-core) event loop.
+        self.idents = idents
+        # active: callable gating sampling; an idle worker skips the
+        # sys._current_frames() call entirely
+        self.active = active
         self.current = create()
         self.history: deque = deque(maxlen=maxlen)  # (timestamp, tree)
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
 
     def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._watch, name="dtpu-profiler", daemon=True
-            )
-            self._thread.start()
+        self._last_sample = 0.0
+        self._last_cycle = time()
+        _shared_watcher.register(self)
 
     def stop(self) -> None:
-        self._stop.set()
+        _shared_watcher.unregister(self)
 
-    def _watch(self) -> None:
-        last_cycle = time()
-        while not self._stop.wait(self.interval):
-            frames = sys._current_frames()
-            idents = {
-                t.ident: t.name
-                for t in threading.enumerate()
-                if self.thread_filter in (t.name or "")
-            }
-            with self._lock:
-                for ident in idents:
-                    frame = frames.get(ident)
-                    if frame is not None:
-                        process(frame, self.current)
-                if time() - last_cycle > self.cycle:
-                    self.history.append((time(), self.current))
-                    self.current = create()
-                    last_cycle = time()
+    # ------------------------------------------- shared-watcher callbacks
+
+    def _due_idents(self, now: float) -> list:
+        """Thread idents to sample this tick ([] when idle or not due)."""
+        if now - getattr(self, "_last_sample", 0.0) < self.interval * 0.5:
+            return []
+        if self.active is not None and not self.active():
+            return []  # nothing executing: don't pay for a sample
+        self._last_sample = now
+        if self.idents is not None:
+            return list(self.idents())
+        return [
+            t.ident
+            for t in threading.enumerate()
+            if self.thread_filter in (t.name or "")
+        ]
+
+    def _add_sample(self, frame, now: float) -> None:
+        with self._lock:
+            process(frame, self.current)
+            if now - self._last_cycle > self.cycle:
+                self.history.append((now, self.current))
+                self.current = create()
+                self._last_cycle = now
 
     def get_profile(self, start: float | None = None) -> dict:
         with self._lock:

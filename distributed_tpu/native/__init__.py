@@ -120,7 +120,7 @@ def load() -> ctypes.CDLL | None:
         lib.graphpack_full.argtypes = [
             ctypes.c_int64, ctypes.c_int64,
             _f32p, _f32p, _i32p, _i32p,
-            ctypes.c_double,
+            ctypes.c_double, ctypes.c_double,
             _i32p, _i32p, _i32p,
             _f32p, _i32p, _i32p, _f32p, _f32p, _f32p,
         ]

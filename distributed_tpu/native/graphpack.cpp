@@ -133,17 +133,27 @@ int64_t graphpack(
 //   xp2_s[i]    transfer seconds if co-located with the 2nd-heaviest dep
 //   xa_s[i]     transfer seconds if placed anywhere else
 // plus level/perm/offsets as in graphpack.
+// ``latency`` is the per-remote-dependency round-trip cost folded into
+// the transfer model here (rather than via numpy post-passes over 1M-row
+// arrays, which cost more than the whole pack): co-location with a dep
+// saves one latency; any other placement pays one per dependency.
 int64_t graphpack_full(
     int64_t T, int64_t E,
     const float* durations, const float* out_bytes,
     const int32_t* src, const int32_t* dst,
-    double inv_bandwidth,
+    double inv_bandwidth, double latency,
     int32_t* level, int32_t* perm, int32_t* offsets,
     float* dur_s, int32_t* heavy_s, int32_t* heavy2_s,
     float* xp_s, float* xp2_s, float* xa_s)
 {
     std::vector<int32_t> heavy(T), heavy2(T);
     std::vector<float> dep_total(T);
+    std::vector<int32_t> indeg(T, 0);
+    for (int64_t e = 0; e < E; ++e) {
+        int32_t s = src[e], d = dst[e];
+        if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
+        indeg[d] += 1;
+    }
     int64_t n_levels = graphpack(T, E, out_bytes, src, dst,
                                  level, perm, heavy.data(), heavy2.data(),
                                  dep_total.data(), offsets);
@@ -151,6 +161,7 @@ int64_t graphpack_full(
     std::vector<int32_t> inv(T);
     for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
     float ibw = (float)inv_bandwidth;
+    float lat = (float)latency;
     for (int64_t i = 0; i < T; ++i) {
         int32_t t = perm[i];
         dur_s[i] = durations[t];
@@ -160,9 +171,11 @@ int64_t graphpack_full(
         heavy2_s[i] = h2 >= 0 ? inv[h2] : -1;
         float hb = h >= 0 ? out_bytes[h] : 0.0f;
         float h2b = h2 >= 0 ? out_bytes[h2] : 0.0f;
-        xa_s[i] = dep_total[t] * ibw;
-        xp_s[i] = (dep_total[t] - hb) * ibw;
-        xp2_s[i] = (dep_total[t] - h2b) * ibw;
+        float deg = (float)indeg[t];
+        float extra = lat * (deg > 1.0f ? deg - 1.0f : 0.0f);
+        xa_s[i] = dep_total[t] * ibw + lat * deg;
+        xp_s[i] = (dep_total[t] - hb) * ibw + extra;
+        xp2_s[i] = (dep_total[t] - h2b) * ibw + extra;
     }
     return n_levels;
 }

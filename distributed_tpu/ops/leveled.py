@@ -172,9 +172,6 @@ def pack_graph(
     dst = np.ascontiguousarray(dst, np.int32)
     T = len(durations)
     E = len(src)
-    indeg = np.zeros(T, np.float32)
-    if E:
-        np.add.at(indeg, dst[(dst >= 0) & (dst < T)], 1.0)
 
     lib = native.load()
     if lib is not None and T:
@@ -189,11 +186,14 @@ def pack_graph(
         xa_s = np.empty(T, np.float32)
         i32p = ctypes.POINTER(ctypes.c_int32)
         f32p = ctypes.POINTER(ctypes.c_float)
+        # latency terms are folded in by the C++ pass (indegree is known
+        # there); numpy post-passes over 1M-row arrays used to cost more
+        # than the pack itself
         n_levels = lib.graphpack_full(
             T, E,
             durations.ctypes.data_as(f32p), out_bytes.ctypes.data_as(f32p),
             src.ctypes.data_as(i32p), dst.ctypes.data_as(i32p),
-            1.0 / bandwidth,
+            1.0 / bandwidth, float(latency),
             level.ctypes.data_as(i32p), perm.ctypes.data_as(i32p),
             offsets_buf.ctypes.data_as(i32p),
             dur_s.ctypes.data_as(f32p), heavy_s.ctypes.data_as(i32p),
@@ -203,12 +203,6 @@ def pack_graph(
         )
         if n_levels < 0:
             raise ValueError("graph has a cycle")
-        if latency:
-            indeg_p = indeg[perm]
-            extra = latency * np.maximum(indeg_p - 1.0, 0.0)
-            xp_s += extra
-            xp2_s += extra
-            xa_s += latency * indeg_p
         return PackedGraph(
             perm=perm, level=level,
             offsets=offsets_buf[: n_levels + 1].copy(),
@@ -217,6 +211,9 @@ def pack_graph(
             xfer_pref_s=xp_s, xfer_pref2_s=xp2_s, xfer_all_s=xa_s,
         )
 
+    indeg = np.zeros(T, np.float32)
+    if E:
+        np.add.at(indeg, dst[(dst >= 0) & (dst < T)], 1.0)
     level, perm, heavy, heavy2, dep_total, offsets, n_levels = _pack_numpy(
         durations, out_bytes, src, dst
     )
@@ -258,6 +255,19 @@ def _bucket(n: int, floor: int = 512) -> int:
     return b
 
 
+def _argmin3(c0, c1, c2):
+    """Elementwise argmin over three cost rows with jnp.argmin's
+    first-minimum tie-break — selects replace [3, F] gathers, which cost
+    ~7 ns/element on TPU (gathers run on the scalar pipeline)."""
+    m01 = jnp.where(c0 <= c1, jnp.int32(0), jnp.int32(1))
+    v01 = jnp.minimum(c0, c1)
+    return jnp.where(v01 <= c2, m01, jnp.int32(2))
+
+
+def _sel3(ch, a0, a1, a2):
+    return jnp.where(ch == 0, a0, jnp.where(ch == 1, a1, a2))
+
+
 # assign/choices/load/spans are donated: they thread through every dispatch
 @functools.partial(
     jax.jit, static_argnames=("F", "K"), donate_argnums=(6, 7, 8, 9)
@@ -282,11 +292,20 @@ def _place_run(
     F: int,     # static bucket size
     K: int,     # static number of fused waves
 ):
+    # TPU cost model: elementwise math is free next to 1-D gathers
+    # (~7 ns/element, scalar pipeline).  The body therefore gathers from
+    # PRECOMBINED per-worker cost tables (one gather per candidate per
+    # pass) and uses arithmetic selects instead of take_along_axis —
+    # 10 F-sized gathers per wave where the naive stacked form costs ~25.
     W = nthreads.shape[0]
     threads_f = jnp.maximum(nthreads, 1).astype(jnp.float32)
+    inv_t = 1.0 / threads_f
     w_run = jnp.maximum((running & (nthreads > 0)).sum(), 1).astype(jnp.int32)
     rank = jnp.arange(F, dtype=jnp.int32)
     INF = jnp.float32(np.inf)
+    # per-worker queue-cost table; +inf marks non-running workers so any
+    # candidate pointing at one loses every argmin without a mask gather
+    ovt0 = jnp.where(running, occ0 * inv_t, INF)
 
     def body(k, carry):
         assign, choices, load, spans = carry
@@ -309,15 +328,15 @@ def _place_run(
         h = jnp.maximum(heavy, 0)
         pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
         p = jnp.maximum(pref, 0)
-        pref_ok = (pref >= 0) & running[p]
+        ok1 = pref >= 0
         h2 = jnp.maximum(heavy2, 0)
         pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
         p2 = jnp.maximum(pref2, 0)
-        pref2_ok = (pref2 >= 0) & running[p2] & (pref2 != pref)
+        ok2 = (pref2 >= 0) & (pref2 != pref)
 
         # spread choice: priority-contiguous equal blocks over the
         # least-loaded running workers (integer block math — exact)
-        order = jnp.argsort(jnp.where(running, load / threads_f, jnp.inf))
+        order = jnp.argsort(jnp.where(running, load * inv_t, jnp.inf))
         # block division instead of rank * w_run // f: the product
         # overflows int32 once F x W exceeds 2^31 (and int64 is
         # unavailable without the x64 flag)
@@ -325,41 +344,41 @@ def _place_run(
         slot = jnp.clip(rank // block, 0, W - 1)
         spread = order[slot]
 
-        cands = jnp.stack([p, p2, spread])           # i32[3, F]
-        xfers = jnp.stack([xp, xp2, xa])             # f32[3, F]
-        oks = jnp.stack(
-            [pref_ok, pref2_ok, jnp.ones_like(pref_ok)]
-        )
-
         # Waves execute after their predecessors complete, so cross-wave
         # occupancy has drained (the reference's occupancy likewise drops
         # on task completion, scheduler.py:3264): costs use the AMBIENT
         # occupancy plus within-wave contention, while the spread
         # ordering above uses cumulative load for cross-wave fairness.
-        def costs_for(extra_load):
-            base = (occ0[cands] + extra_load) / threads_f[cands] + xfers
-            return jnp.where(oks, base, INF)
+        c0 = jnp.where(ok1, ovt0[p] + xp, INF)
+        c1 = jnp.where(ok2, ovt0[p2] + xp2, INF)
+        c2 = ovt0[spread] + xa  # spread targets running workers only
+        choice = _argmin3(c0, c1, c2)
+        tent = _sel3(choice, p, p2, spread)
+        xfer_t = _sel3(choice, xp, xp2, xa)
 
-        choice = jnp.argmin(costs_for(jnp.zeros((3, F), jnp.float32)), axis=0)
-        tent = jnp.take_along_axis(cands, choice[None], 0)[0]
-        xfer_t = jnp.take_along_axis(xfers, choice[None], 0)[0]
-
-        # one Jacobi contention round against the tentative wave load
+        # one Jacobi contention round against the tentative wave load:
+        # cost = (occ0 + tl - own_contribution) / threads + xfer, with
+        # the per-worker part prefolded into s_tab = ovt0 + tl / threads
         tw = jnp.where(valid, dur + xfer_t, 0.0)
         tl = jax.ops.segment_sum(tw, jnp.maximum(tent, 0), num_segments=W)
-        others = tl[cands] - jnp.where(cands == tent[None], tw[None], 0.0)
-        choice = jnp.argmin(costs_for(others), axis=0)
-
-        assign_w = jnp.take_along_axis(cands, choice[None], 0)[0]
-        xfer = jnp.take_along_axis(xfers, choice[None], 0)[0]
-        assign_w = jnp.where(valid & running[assign_w], assign_w, -1)
+        s_tab = ovt0 + tl * inv_t
+        corr = tw * inv_t[tent]  # own contribution, only where cand == tent
+        d0 = jnp.where(ok1, s_tab[p] - jnp.where(p == tent, corr, 0.0) + xp, INF)
+        d1 = jnp.where(ok2, s_tab[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2, INF)
+        d2 = s_tab[spread] - jnp.where(spread == tent, corr, 0.0) + xa
+        choice = _argmin3(d0, d1, d2)
+        assign_w = _sel3(choice, p, p2, spread)
+        xfer = _sel3(choice, xp, xp2, xa)
+        # d2 is always finite (spread is running), so validity alone
+        # decides placement — non-running prefs were +inf and never win
+        assign_w = jnp.where(valid, assign_w, -1)
 
         work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
         wave_load = jax.ops.segment_sum(
             work, jnp.maximum(assign_w, 0), num_segments=W
         )
         load = load + wave_load
-        span = jnp.where(running, wave_load / threads_f, 0.0).max()
+        span = jnp.where(running, wave_load * inv_t, 0.0).max()
         spans = spans.at[widxs[k]].set(span)
         # padding lanes write -1 into [offset+f, offset+F) — slots of
         # LATER waves, which are still -1 and will be overwritten by
@@ -425,15 +444,24 @@ def place_graph_leveled(
     T = packed.n
     L = packed.n_levels
     sizes = np.diff(packed.offsets)
-    fmax_bucket = _bucket(int(sizes.max()) if L else 1)
-    # dynamic_slice windows never clamp backward (fused runs use
-    # SMALL_WAVE-sized windows even when every wave is smaller)
-    Tp = T + max(fmax_bucket, SMALL_WAVE)
+    runs = _plan_runs(packed.offsets)
+    # exact pad: just enough that no dynamic_slice window (real wave at
+    # its offset, padding wave parked at T) reads past the buffer — the
+    # old worst-case pad (max bucket) shipped up to 8 MB of padding per
+    # array over the wire at 1M tasks
+    pad = 16
+    for F, waves in runs:
+        if _bucket(len(waves), floor=1) > len(waves):
+            pad = max(pad, F)  # padding waves use window [T, T+F)
+        for w in waves:
+            pad = max(pad, int(packed.offsets[w]) + F - T)
+    Tp = T + pad
     Lp = _bucket(L + 1, floor=64)  # +1: scratch slot for padding waves
 
     def up(arr, fill, dtype):
-        buf = np.full(Tp, fill, dtype)
+        buf = np.empty(Tp, dtype)
         buf[:T] = arr
+        buf[T:] = fill
         return jax.device_put(buf)
 
     # 16 bytes/task on the wire
@@ -452,7 +480,7 @@ def place_graph_leveled(
     nthreads = jnp.asarray(np.asarray(nthreads, np.int32))
     running = jnp.asarray(np.asarray(running, bool))
 
-    for F, waves in _plan_runs(packed.offsets):
+    for F, waves in runs:
         K = _bucket(len(waves), floor=1)
         # padding waves (f=0) place nothing, but their update window
         # still writes -1 over [off, off+F) — park it on the pad tail

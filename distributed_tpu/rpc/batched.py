@@ -53,12 +53,15 @@ class BatchedSend:
         self.waker.set()
 
     async def _background_send(self) -> None:
+        # idle streams block on the waker with NO timer: the previous
+        # wait_for(..., interval) tick created a Task + timeout context +
+        # heap timer per stream per 2 ms — with ~2 streams per worker
+        # that alone measurably loaded a single-core event loop.  A burst
+        # flushes immediately; the coalescing window applies between
+        # flushes, not in front of the first.
         try:
             while not self.please_stop:
-                try:
-                    await asyncio.wait_for(self.waker.wait(), self.interval)
-                except asyncio.TimeoutError:
-                    pass
+                await self.waker.wait()
                 self.waker.clear()
                 if not self.buffer:
                     if self.please_stop:
@@ -74,6 +77,8 @@ class BatchedSend:
                     payload.extend(self.buffer)
                     self.buffer = deque(payload)
                     break
+                if self.interval and not self.please_stop:
+                    await asyncio.sleep(self.interval)
         finally:
             self.stopped.set()
 

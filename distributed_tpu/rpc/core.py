@@ -161,11 +161,12 @@ def error_message(e: BaseException) -> dict:
 
 def raise_remote_error(resp: dict) -> None:
     if "exception" not in resp:
-        # a handler replied {"status": "error", "error": "..."} without a
-        # pickled exception envelope: surface it instead of KeyError
+        # direct callers (client report stream, actors) may pass an
+        # error-status reply that carries no pickled envelope — surface
+        # a clear RPCError instead of KeyError masking the message
         from distributed_tpu.exceptions import RPCError
 
-        raise RPCError(resp.get("error", repr(resp)))
+        raise RPCError(resp.get("error", resp.get("message", repr(resp))))
     exc = _pickle.loads(resp["exception"])
     if resp.get("traceback-text"):
         note = f"\n\nRemote traceback:\n{resp['traceback-text']}"
@@ -495,9 +496,14 @@ async def send_recv(comm: Comm, *, op: str, reply: bool = True, **kwargs: Any) -
     if not reply:
         return None
     resp = await comm.read()
-    if isinstance(resp, dict) and resp.get("status") == "error":
-        raise_remote_error(resp)
-    if isinstance(resp, dict) and resp.get("status") == "uncaught-error":
+    # only replies carrying a pickled exception are error ENVELOPES;
+    # handlers may use status "error" as structured protocol data (e.g.
+    # Scheduler.gather's missing-keys reply, which the client handles)
+    if (
+        isinstance(resp, dict)
+        and resp.get("status") in ("error", "uncaught-error")
+        and "exception" in resp
+    ):
         raise_remote_error(resp)
     return resp
 

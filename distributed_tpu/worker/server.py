@@ -184,7 +184,22 @@ class Worker(Server):
         if config.get("worker.profile.enabled"):
             from distributed_tpu.diagnostics.profile import Profiler
 
-            self.profiler = Profiler(thread_filter=self._exec_prefix)
+            # sample exactly our executor threads, and only while
+            # something is executing — N in-proc workers enumerating
+            # every process thread at 100 Hz starved the event loop.
+            # _threads is ThreadPoolExecutor-private: if a future
+            # executor lacks it, fall back to the name-filter path
+            # rather than silently sampling nothing
+            idents = None
+            if hasattr(self.executor, "_threads"):
+                idents = lambda: [  # noqa: E731
+                    t.ident for t in self.executor._threads
+                ]
+            self.profiler = Profiler(
+                thread_filter=self._exec_prefix,
+                idents=idents,
+                active=lambda: bool(self.state.executing),
+            )
         self.memory_manager = None
         if memory_limit:
             from distributed_tpu.worker.memory import WorkerMemoryManager
