@@ -38,7 +38,7 @@ def _needs_build() -> bool:
 
 def _build() -> bool:
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
         *_SOURCES, "-o", _LIB_PATH,
     ]
     try:
@@ -123,6 +123,11 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_double, ctypes.c_double,
             _i32p, _i32p, _i32p,
             _f32p, _i32p, _i32p, _f32p, _f32p, _f32p,
+        ]
+        lib.unpack_assignment.restype = None
+        lib.unpack_assignment.argtypes = [
+            ctypes.c_int64, _i32p, _i32p, _i32p,
+            ctypes.POINTER(ctypes.c_int8),
         ]
         _lib = lib
         return _lib

@@ -88,38 +88,37 @@ int64_t graphpack(
         }
     }
 
-    // Kahn's algorithm, level-synchronous; frontier queues reused
+    // Kahn's algorithm, level-synchronous.  Frontier order within a
+    // level does NOT matter for level assignment, so no per-level sort
+    // happens here (the old per-level std::sort dominated the pack at
+    // 1M tasks); the stable (level, original-index) permutation is
+    // rebuilt afterwards with one counting sort over levels.
     std::vector<int32_t> frontier, next;
     frontier.reserve(T);
     next.reserve(T);
     for (int64_t t = 0; t < T; ++t)
         if (indeg[t] == 0) frontier.push_back((int32_t)t);
 
-    int64_t placed = 0, n_levels = 0, pi = 0;
+    int64_t placed = 0, n_levels = 0;
     while (!frontier.empty()) {
-        offsets[n_levels] = (int32_t)pi;
-        // frontier preserves ascending original index within a level:
-        // it is filled either by the ordered initial scan or by the
-        // ordered sweep below, keeping the (level, index) sort stable
-        for (int32_t t : frontier) {
-            level[t] = (int32_t)n_levels;
-            perm[pi++] = t;
-        }
+        for (int32_t t : frontier) level[t] = (int32_t)n_levels;
         placed += (int64_t)frontier.size();
         next.clear();
         for (int32_t t : frontier)
             for (int64_t j = outptr[t]; j < outptr[t + 1]; ++j)
                 if (--indeg[outadj[j]] == 0) next.push_back(outadj[j]);
-        // keep within-level order sorted by original index (stable
-        // priority order).  next is built producer-by-producer so it can
-        // be out of order; an insertion-friendly counting approach would
-        // be O(T) per level, so sort the (typically small) frontier.
-        std::sort(next.begin(), next.end());
         frontier.swap(next);
         ++n_levels;
     }
-    offsets[n_levels] = (int32_t)pi;
     if (placed != T) return -1;  // cycle
+
+    // counting sort by level; scanning tasks in ascending original
+    // index keeps the within-level order stable by construction
+    std::vector<int64_t> fill(n_levels + 1, 0);
+    for (int64_t t = 0; t < T; ++t) fill[level[t] + 1] += 1;
+    for (int64_t l = 0; l < n_levels; ++l) fill[l + 1] += fill[l];
+    for (int64_t l = 0; l <= n_levels; ++l) offsets[l] = (int32_t)fill[l];
+    for (int64_t t = 0; t < T; ++t) perm[fill[level[t]]++] = (int32_t)t;
     return n_levels;
 }
 
@@ -178,6 +177,23 @@ int64_t graphpack_full(
         xp2_s[i] = (dep_total[t] - h2b) * ibw + extra;
     }
     return n_levels;
+}
+
+// Post-pass for the device placement result: one cache-friendly sweep
+// replaces four numpy passes + two 1M-row fancy-index scatters
+// (packed -> assignment/choice in ORIGINAL task order).
+//   packed_h[i] = (assign_sorted[i] + 1) * 4 + choice_sorted[i]
+void unpack_assignment(
+    int64_t T,
+    const int32_t* packed_h, const int32_t* perm,
+    int32_t* assignment, int8_t* choice)
+{
+    for (int64_t i = 0; i < T; ++i) {
+        int32_t v = packed_h[i];
+        int32_t t = perm[i];
+        assignment[t] = v / 4 - 1;
+        choice[t] = (int8_t)(v & 3);
+    }
 }
 
 }  // extern "C"

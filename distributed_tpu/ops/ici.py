@@ -46,23 +46,30 @@ def _mix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=64)
-def _shuffle_program(mesh: Mesh, axis: str, n_dev: int, B: int):
+def _shuffle_program(mesh: Mesh, axis: str, n_dev: int, B: int,
+                     masked: bool):
     """Build + jit the exchange once per (mesh, axis, capacity): a fresh
     closure per call would defeat jit's function-identity cache and
     recompile every shuffle."""
 
-    def local(keys_l, vals_l):
+    def local(keys_l, vals_l, *rest):
         # per-device: bucket rows by destination, pad to [n_dev, B]
         n = keys_l.shape[0]
         dest = (_mix32(keys_l) % jnp.uint32(n_dev)).astype(jnp.int32)
+        if masked:
+            # invalid rows (ragged-partition padding) route to the
+            # discard row n_dev of the send buffer and count nowhere
+            dest = jnp.where(rest[0], dest, jnp.int32(n_dev))
         order = jnp.argsort(dest)
         sdest = dest[order]
         counts = jnp.bincount(dest, length=n_dev)
         starts = jnp.concatenate(
             [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]]
         )
-        within = jnp.arange(n) - starts[sdest]
-        in_cap = within < B  # truncated rows are reported via counts
+        within = jnp.arange(n) - starts[jnp.minimum(sdest, n_dev - 1)]
+        # truncated rows are reported via counts; masked rows (sdest ==
+        # n_dev) always land in the discard row
+        in_cap = (within < B) & (sdest < jnp.int32(n_dev))
         dst_rows = jnp.where(in_cap, sdest, n_dev)
         dst_cols = jnp.where(in_cap, within, 0)
         send_k = jnp.zeros((n_dev + 1, B), keys_l.dtype)
@@ -79,10 +86,11 @@ def _shuffle_program(mesh: Mesh, axis: str, n_dev: int, B: int):
         sent_c = counts  # pre-exchange view, for detection at the source
         return recv_k, recv_v, recv_c, sent_c
 
+    in_specs = (P(axis), P(axis)) + ((P(axis),) if masked else ())
     shard = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P(axis)),
+        in_specs=in_specs,
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
         check_vma=False,
     )
@@ -95,6 +103,7 @@ def shuffle_on_mesh(
     values: Any,
     axis: str = "shuffle",
     capacity: int | None = None,
+    valid: Any = None,
 ):
     """Device-native hash shuffle: row (k, v) moves to device
     ``hash(k) % n_devices`` entirely over the mesh interconnect.
@@ -105,13 +114,19 @@ def shuffle_on_mesh(
     mesh axis) plus the TRUE per-block counts on both ends — mask valid
     rows with ``min(count, capacity)``; a count above capacity means
     that block was truncated.
+
+    ``valid``: optional bool [N] sharded like keys — False rows (the
+    padding of ragged partitions) are dropped instead of exchanged.
     """
     n_dev = mesh.shape[axis]
     n_local = keys.shape[0] // n_dev
     if capacity is None:
         # 2x headroom over the uniform expectation, at least 16
         capacity = max(16, (2 * n_local + n_dev - 1) // n_dev)
-    return _shuffle_program(mesh, axis, n_dev, int(capacity))(keys, values)
+    prog = _shuffle_program(mesh, axis, n_dev, int(capacity), valid is not None)
+    if valid is not None:
+        return prog(keys, values, valid)
+    return prog(keys, values)
 
 
 def compact_shuffle_output(keys_out, values_out, counts, n_dev: int):

@@ -270,7 +270,7 @@ def _sel3(ch, a0, a1, a2):
 
 # assign/choices/load/spans are donated: they thread through every dispatch
 @functools.partial(
-    jax.jit, static_argnames=("F", "K"), donate_argnums=(6, 7, 8, 9)
+    jax.jit, static_argnames=("F", "K", "uniform"), donate_argnums=(6, 7, 8, 9)
 )
 def _place_run(
     dur_g,      # f16[Tp] level-sorted durations (device-resident)
@@ -291,12 +291,17 @@ def _place_run(
     occ0,       # f32[W] ambient occupancy at request time
     F: int,     # static bucket size
     K: int,     # static number of fused waves
+    uniform: bool = False,  # every worker running, equal occ0 & nthreads
 ):
     # TPU cost model: elementwise math is free next to 1-D gathers
     # (~7 ns/element, scalar pipeline).  The body therefore gathers from
     # PRECOMBINED per-worker cost tables (one gather per candidate per
     # pass) and uses arithmetic selects instead of take_along_axis —
-    # 10 F-sized gathers per wave where the naive stacked form costs ~25.
+    # ~10 F-sized gathers per wave where the naive stacked form costs
+    # ~25, and 6 on the ``uniform`` fast path (a homogeneous idle fleet,
+    # the common whole-graph-planning case, makes the per-worker queue
+    # cost a SCALAR: three table gathers vanish from pass 1 and the
+    # per-thread correction needs no gather in pass 2).
     W = nthreads.shape[0]
     threads_f = jnp.maximum(nthreads, 1).astype(jnp.float32)
     inv_t = 1.0 / threads_f
@@ -306,99 +311,144 @@ def _place_run(
     # per-worker queue-cost table; +inf marks non-running workers so any
     # candidate pointing at one loses every argmin without a mask gather
     ovt0 = jnp.where(running, occ0 * inv_t, INF)
+    ovt_c = occ0[0] * inv_t[0]  # uniform-path scalar
+    inv_c = inv_t[0]
 
     def body(k, carry):
-        assign, choices, load, spans = carry
         offset = offs[k]
         f = fs[k]
 
-        dur = lax.dynamic_slice(dur_g, (offset,), (F,)).astype(jnp.float32)
-        heavy = lax.dynamic_slice(heavy_g, (offset,), (F,))
-        heavy2 = lax.dynamic_slice(heavy2_g, (offset,), (F,))
-        xp = lax.dynamic_slice(xp_g, (offset,), (F,)).astype(jnp.float32)
-        xp2 = lax.dynamic_slice(xp2_g, (offset,), (F,)).astype(jnp.float32)
-        xa = lax.dynamic_slice(xa_g, (offset,), (F,)).astype(jnp.float32)
-        valid = rank < f
+        def run_wave(carry):
+            assign, choices, load, spans = carry
+            dur = lax.dynamic_slice(dur_g, (offset,), (F,)).astype(jnp.float32)
+            heavy = lax.dynamic_slice(heavy_g, (offset,), (F,))
+            heavy2 = lax.dynamic_slice(heavy2_g, (offset,), (F,))
+            xp = lax.dynamic_slice(xp_g, (offset,), (F,)).astype(jnp.float32)
+            xp2 = lax.dynamic_slice(xp2_g, (offset,), (F,)).astype(jnp.float32)
+            xa = lax.dynamic_slice(xa_g, (offset,), (F,)).astype(jnp.float32)
+            valid = rank < f
 
-        # locality candidates: the workers that produced the two
-        # heaviest dependencies (join-shaped tasks — tensordot, merge —
-        # have two comparable inputs; co-locating with either saves a
-        # fetch, mirroring decide_worker's who_has candidate set,
-        # reference scheduler.py:8550)
-        h = jnp.maximum(heavy, 0)
-        pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
-        p = jnp.maximum(pref, 0)
-        ok1 = pref >= 0
-        h2 = jnp.maximum(heavy2, 0)
-        pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
-        p2 = jnp.maximum(pref2, 0)
-        ok2 = (pref2 >= 0) & (pref2 != pref)
+            # locality candidates: the workers that produced the two
+            # heaviest dependencies (join-shaped tasks — tensordot,
+            # merge — have two comparable inputs; co-locating with
+            # either saves a fetch, mirroring decide_worker's who_has
+            # candidate set, reference scheduler.py:8550)
+            h = jnp.maximum(heavy, 0)
+            pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
+            p = jnp.maximum(pref, 0)
+            ok1 = pref >= 0
+            h2 = jnp.maximum(heavy2, 0)
+            pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
+            p2 = jnp.maximum(pref2, 0)
+            ok2 = (pref2 >= 0) & (pref2 != pref)
 
-        # spread choice: priority-contiguous equal blocks over the
-        # least-loaded running workers (integer block math — exact)
-        order = jnp.argsort(jnp.where(running, load * inv_t, jnp.inf))
-        # block division instead of rank * w_run // f: the product
-        # overflows int32 once F x W exceeds 2^31 (and int64 is
-        # unavailable without the x64 flag)
-        block = jnp.maximum((f + w_run - 1) // w_run, 1)
-        slot = jnp.clip(rank // block, 0, W - 1)
-        spread = order[slot]
+            # spread choice: priority-contiguous equal blocks over the
+            # least-loaded running workers (integer block math — exact)
+            order = jnp.argsort(jnp.where(running, load * inv_t, jnp.inf))
+            # block division instead of rank * w_run // f: the product
+            # overflows int32 once F x W exceeds 2^31 (and int64 is
+            # unavailable without the x64 flag)
+            block = jnp.maximum((f + w_run - 1) // w_run, 1)
+            slot = jnp.clip(rank // block, 0, W - 1)
+            spread = order[slot]
 
-        # Waves execute after their predecessors complete, so cross-wave
-        # occupancy has drained (the reference's occupancy likewise drops
-        # on task completion, scheduler.py:3264): costs use the AMBIENT
-        # occupancy plus within-wave contention, while the spread
-        # ordering above uses cumulative load for cross-wave fairness.
-        c0 = jnp.where(ok1, ovt0[p] + xp, INF)
-        c1 = jnp.where(ok2, ovt0[p2] + xp2, INF)
-        c2 = ovt0[spread] + xa  # spread targets running workers only
-        choice = _argmin3(c0, c1, c2)
-        tent = _sel3(choice, p, p2, spread)
-        xfer_t = _sel3(choice, xp, xp2, xa)
+            # Waves execute after their predecessors complete, so
+            # cross-wave occupancy has drained (the reference's occupancy
+            # likewise drops on task completion, scheduler.py:3264):
+            # costs use the AMBIENT occupancy plus within-wave
+            # contention, while the spread ordering above uses cumulative
+            # load for cross-wave fairness.
+            if uniform:
+                c0 = jnp.where(ok1, xp + ovt_c, INF)
+                c1 = jnp.where(ok2, xp2 + ovt_c, INF)
+                c2 = xa + ovt_c
+            else:
+                c0 = jnp.where(ok1, ovt0[p] + xp, INF)
+                c1 = jnp.where(ok2, ovt0[p2] + xp2, INF)
+                c2 = ovt0[spread] + xa  # spread targets running workers
+            choice = _argmin3(c0, c1, c2)
+            tent = _sel3(choice, p, p2, spread)
+            xfer_t = _sel3(choice, xp, xp2, xa)
 
-        # one Jacobi contention round against the tentative wave load:
-        # cost = (occ0 + tl - own_contribution) / threads + xfer, with
-        # the per-worker part prefolded into s_tab = ovt0 + tl / threads
-        tw = jnp.where(valid, dur + xfer_t, 0.0)
-        tl = jax.ops.segment_sum(tw, jnp.maximum(tent, 0), num_segments=W)
-        s_tab = ovt0 + tl * inv_t
-        corr = tw * inv_t[tent]  # own contribution, only where cand == tent
-        d0 = jnp.where(ok1, s_tab[p] - jnp.where(p == tent, corr, 0.0) + xp, INF)
-        d1 = jnp.where(ok2, s_tab[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2, INF)
-        d2 = s_tab[spread] - jnp.where(spread == tent, corr, 0.0) + xa
-        choice = _argmin3(d0, d1, d2)
-        assign_w = _sel3(choice, p, p2, spread)
-        xfer = _sel3(choice, xp, xp2, xa)
-        # d2 is always finite (spread is running), so validity alone
-        # decides placement — non-running prefs were +inf and never win
-        assign_w = jnp.where(valid, assign_w, -1)
+            # one Jacobi contention round against the tentative wave
+            # load: cost = (occ0 + tl - own_contribution) / threads +
+            # xfer, prefolded into s_tab = ovt0 + tl / threads
+            tw = jnp.where(valid, dur + xfer_t, 0.0)
+            tl = jax.ops.segment_sum(
+                tw, jnp.maximum(tent, 0), num_segments=W
+            )
+            if uniform:
+                tli = tl * inv_c
+                corr = tw * inv_c
+                d0 = jnp.where(
+                    ok1,
+                    tli[p] - jnp.where(p == tent, corr, 0.0) + xp + ovt_c,
+                    INF,
+                )
+                d1 = jnp.where(
+                    ok2,
+                    tli[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2 + ovt_c,
+                    INF,
+                )
+                d2 = (
+                    tli[spread]
+                    - jnp.where(spread == tent, corr, 0.0)
+                    + xa + ovt_c
+                )
+            else:
+                s_tab = ovt0 + tl * inv_t
+                corr = tw * inv_t[tent]  # own share, only where cand == tent
+                d0 = jnp.where(
+                    ok1, s_tab[p] - jnp.where(p == tent, corr, 0.0) + xp, INF
+                )
+                d1 = jnp.where(
+                    ok2,
+                    s_tab[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2,
+                    INF,
+                )
+                d2 = s_tab[spread] - jnp.where(spread == tent, corr, 0.0) + xa
+            choice = _argmin3(d0, d1, d2)
+            assign_w = _sel3(choice, p, p2, spread)
+            xfer = _sel3(choice, xp, xp2, xa)
+            # d2 is always finite (spread is running), so validity alone
+            # decides placement — non-running prefs are +inf, never win
+            assign_w = jnp.where(valid, assign_w, -1)
 
-        work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
-        wave_load = jax.ops.segment_sum(
-            work, jnp.maximum(assign_w, 0), num_segments=W
-        )
-        load = load + wave_load
-        span = jnp.where(running, wave_load * inv_t, 0.0).max()
-        spans = spans.at[widxs[k]].set(span)
-        # padding lanes write -1 into [offset+f, offset+F) — slots of
-        # LATER waves, which are still -1 and will be overwritten by
-        # their own wave (arrays are padded past T so the update window
-        # never clamps backward)
-        assign = lax.dynamic_update_slice(assign, assign_w, (offset,))
-        choices = lax.dynamic_update_slice(choices, choice, (offset,))
-        return assign, choices, load, spans
+            work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
+            wave_load = jax.ops.segment_sum(
+                work, jnp.maximum(assign_w, 0), num_segments=W
+            )
+            load = load + wave_load
+            span = jnp.where(running, wave_load * inv_t, 0.0).max()
+            spans = spans.at[widxs[k]].set(span)
+            # padding lanes write -1 into [offset+f, offset+F) — slots
+            # of LATER waves, still -1 and overwritten by their own wave
+            # (arrays are padded past T so the window never clamps back)
+            assign = lax.dynamic_update_slice(assign, assign_w, (offset,))
+            choices = lax.dynamic_update_slice(choices, choice, (offset,))
+            return assign, choices, load, spans
+
+        if K == 1:
+            return run_wave(carry)
+        # padding waves (f == 0) skip the whole body: a fused run rounds
+        # its wave count up to a power of two and the no-op iterations
+        # would otherwise pay full F-sized gathers each
+        return lax.cond(f > 0, run_wave, lambda c: c, carry)
 
     if K == 1:
         return body(0, (assign, choices, load, spans))
     return lax.fori_loop(0, K, body, (assign, choices, load, spans))
 
 
-@functools.partial(jax.jit, static_argnames=("T", "wide"), donate_argnums=())
-def _shrink_assignment(assign, choices, T: int, wide: bool):
-    """Drop padding and pack (assignment, choice) into one download:
-    ``(assign+1)*4 + choice`` — int16 when worker ids fit, so the wire
-    cost stays 2 bytes/task on tunneled backends."""
-    out = (assign[:T] + 1) * 4 + jnp.clip(choices[:T], 0, 2)
+@functools.partial(jax.jit, static_argnames=("L", "wide"))
+def _shrink_window(assign, choices, start, L: int, wide: bool):
+    """Packed (assignment, choice) for rows [start, start+L): the
+    segmented-download variant — rows final after run k are fetched
+    while later runs still compute, hiding the D2H time behind the
+    remaining device work."""
+    a = lax.dynamic_slice(assign, (start,), (L,))
+    c = lax.dynamic_slice(choices, (start,), (L,))
+    out = (a + 1) * 4 + jnp.clip(c, 0, 2)
     return out if wide else out.astype(jnp.int16)
 
 
@@ -412,21 +462,27 @@ class LeveledResult(NamedTuple):
 
 
 def _plan_runs(offsets: np.ndarray) -> list[tuple[int, list[int]]]:
-    """Group consecutive small waves into fused runs: [(F, [wave,...])]."""
+    """Group consecutive same-bucket waves into fused runs:
+    [(F, [wave,...])].  Small waves share the SMALL_WAVE bucket; larger
+    consecutive waves with the same power-of-two bucket fuse too — one
+    fori_loop dispatch per group instead of one program per wave (the
+    separate-program overhead dominates mid-sized waves)."""
     sizes = np.diff(offsets)
     runs: list[tuple[int, list[int]]] = []
     cur: list[int] = []
+    cur_f = 0
     for w, f in enumerate(sizes):
-        b = _bucket(int(f))
-        if b <= SMALL_WAVE:
+        b = max(_bucket(int(f)), 0)
+        target = SMALL_WAVE if b <= SMALL_WAVE else b
+        if cur and target == cur_f:
             cur.append(w)
             continue
         if cur:
-            runs.append((SMALL_WAVE, cur))
-            cur = []
-        runs.append((b, [w]))
+            runs.append((cur_f, cur))
+        cur = [w]
+        cur_f = target
     if cur:
-        runs.append((SMALL_WAVE, cur))
+        runs.append((cur_f, cur))
     return runs
 
 
@@ -458,29 +514,51 @@ def place_graph_leveled(
     Tp = T + pad
     Lp = _bucket(L + 1, floor=64)  # +1: scratch slot for padding waves
 
-    def up(arr, fill, dtype):
+    def pad_buf(arr, fill, dtype):
         buf = np.empty(Tp, dtype)
         buf[:T] = arr
         buf[T:] = fill
-        return jax.device_put(buf)
+        return buf
 
-    # 16 bytes/task on the wire
-    dur_g = up(packed.duration_s, 0, np.float16)
-    heavy_g = up(packed.heavy_s, 0, np.int32)  # pad 0: safe gather index
-    heavy2_g = up(packed.heavy2_s, 0, np.int32)
-    xp_g = up(packed.xfer_pref_s, 0, np.float16)
-    xp2_g = up(packed.xfer_pref2_s, 0, np.float16)
-    xa_g = up(packed.xfer_all_s, 0, np.float16)
+    # 16 bytes/task on the wire; ONE device_put call for the whole set
+    # (per-call staging overhead is material on a single-core host)
+    dur_g, heavy_g, heavy2_g, xp_g, xp2_g, xa_g = jax.device_put((
+        pad_buf(packed.duration_s, 0, np.float16),
+        pad_buf(packed.heavy_s, 0, np.int32),  # pad 0: safe gather index
+        pad_buf(packed.heavy2_s, 0, np.int32),
+        pad_buf(packed.xfer_pref_s, 0, np.float16),
+        pad_buf(packed.xfer_pref2_s, 0, np.float16),
+        pad_buf(packed.xfer_all_s, 0, np.float16),
+    ))
+
+    occ_h = np.asarray(occupancy0, np.float32)
+    thr_h = np.asarray(nthreads, np.int32)
+    run_h = np.asarray(running, bool)
+    W = len(occ_h)
+    wide = (W + 1) * 4 + 3 > 32767
+    # homogeneous idle fleet: the per-worker queue cost is a scalar and
+    # the kernel drops 4 of its ~10 F-sized gathers per wave
+    uniform = bool(
+        W > 0 and run_h.all() and np.ptp(occ_h) == 0 and np.ptp(thr_h) == 0
+    )
 
     assign = jnp.full(Tp, -1, jnp.int32)
     choices = jnp.full(Tp, 2, jnp.int32)
-    occ0 = jnp.asarray(np.asarray(occupancy0, np.float32))
+    occ0 = jnp.asarray(occ_h)
     load = occ0 + 0.0  # distinct buffer: load is donated, occ0 is not
     spans = jnp.zeros(Lp, jnp.float32)
-    nthreads = jnp.asarray(np.asarray(nthreads, np.int32))
-    running = jnp.asarray(np.asarray(running, bool))
-
-    for F, waves in runs:
+    nthreads = jnp.asarray(thr_h)
+    running = jnp.asarray(run_h)
+    # segmented downloads: rows [0, end_of_run_k) are FINAL once run k's
+    # dispatch completes (later runs only write later rows + pad tail),
+    # so fetch them asynchronously while the remaining runs compute —
+    # the last segment is the only D2H the host actually waits for.
+    # Window lengths are bucketed (bounded jit shapes); windows overlap
+    # backward into already-fetched rows, which the host just rewrites.
+    segments: list = []  # (start, window, device_array)
+    seg_from = 0
+    SEG_MIN = max(T // 4, 4096)
+    for run_i, (F, waves) in enumerate(runs):
         K = _bucket(len(waves), floor=1)
         # padding waves (f=0) place nothing, but their update window
         # still writes -1 over [off, off+F) — park it on the pad tail
@@ -495,24 +573,56 @@ def place_graph_leveled(
             dur_g, heavy_g, heavy2_g, xp_g, xp2_g, xa_g,
             assign, choices, load, spans,
             jnp.asarray(offs), jnp.asarray(fs), jnp.asarray(widxs),
-            nthreads, running, occ0, F=F, K=K,
+            nthreads, running, occ0, F=F, K=K, uniform=uniform,
         )
+        rows_done = int(packed.offsets[waves[-1] + 1])
+        if rows_done - seg_from >= SEG_MIN or (
+            run_i == len(runs) - 1 and rows_done > seg_from
+        ):
+            # window must fit the Tp-sized buffers: the pow2 bucket can
+            # overshoot them for graphs a bit over a power of two, so
+            # clamp — a window reaching past rows_done only copies rows
+            # a LATER (always-overlapping-backward) segment rewrites
+            Lw = min(_bucket(rows_done - seg_from, floor=4096), Tp)
+            start = max(rows_done - Lw, 0)
+            seg = _shrink_window(
+                assign, choices, jnp.int32(start), L=Lw, wide=wide
+            )
+            try:
+                seg.copy_to_host_async()
+            except AttributeError:  # pragma: no cover - non-array backend
+                pass
+            segments.append((start, Lw, seg))
+            seg_from = rows_done
 
-    W = len(np.asarray(occupancy0))
-    small = _shrink_assignment(
-        assign, choices, T=T, wide=(W + 1) * 4 + 3 > 32767
-    )
-    # single synchronization point: fetch results
-    packed_h = np.asarray(small).astype(np.int32)
-    assign_h = packed_h // 4 - 1
-    choice_h = (packed_h % 4).astype(np.int8)
+    packed_h = np.empty(max(T, 1), np.int32)
+    for start, Lw, seg in segments:
+        end = min(start + Lw, T)
+        packed_h[start:end] = np.asarray(seg)[: end - start]
+    packed_h = packed_h[:T]
     spans_h = np.asarray(spans)[:L]
     load_h = np.asarray(load)
 
     assignment = np.full(T, -1, np.int32)
-    assignment[packed.perm] = assign_h
     choice = np.full(T, 2, np.int8)
-    choice[packed.perm] = choice_h
+    from distributed_tpu import native
+
+    lib = native.load_nowait() or native.load()
+    if lib is not None and T:
+        # one C sweep instead of four numpy passes + two fancy scatters
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.unpack_assignment(
+            T,
+            np.ascontiguousarray(packed_h).ctypes.data_as(i32p),
+            np.ascontiguousarray(packed.perm).ctypes.data_as(i32p),
+            assignment.ctypes.data_as(i32p),
+            choice.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        )
+    elif T:
+        assign_h = packed_h // 4 - 1
+        choice_h = (packed_h % 4).astype(np.int8)
+        assignment[packed.perm] = assign_h
+        choice[packed.perm] = choice_h
     wave_start = np.concatenate([[0.0], np.cumsum(spans_h)[:-1]]).astype(np.float32)
     start_time = wave_start[np.maximum(packed.level, 0)] if L else np.zeros(T, np.float32)
     return LeveledResult(

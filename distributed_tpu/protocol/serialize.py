@@ -195,11 +195,33 @@ register_serialization_family("numpy", _numpy_dumps, _numpy_loads)
 
 
 def _jax_dumps(x) -> tuple[dict, list]:
+    """Host-wire export of a jax array.
+
+    Host-backed arrays (cpu platform) export zero-copy through dlpack —
+    no device_get, no buffer copy.  Accelerator-resident arrays pay ONE
+    D2H copy, which a host wire hop fundamentally requires; bulk device
+    data should never reach this path at all — device-to-device movement
+    rides the mesh collectives (shuffle/device.py, ops/ici.py), and
+    in-process comms pass arrays by reference (the role of reference
+    comm/ucx.py:211's device frames).
+    """
     import numpy as np
 
-    host = np.asarray(x)  # device_get; dlpack zero-copy when already on host
+    platform = "unknown"
+    try:
+        platform = next(iter(x.devices())).platform
+    except Exception:
+        pass
+    if platform == "cpu":
+        try:
+            host = np.from_dlpack(x)  # zero-copy view of the host buffer
+        except (TypeError, RuntimeError, BufferError):
+            host = np.asarray(x)
+    else:
+        host = np.asarray(x)
     header, frames = _numpy_dumps(host)
     header["serializer"] = "jax"
+    header["platform"] = platform
     # weak_type/committed intentionally dropped: data-plane values
     return header, frames
 

@@ -16,11 +16,19 @@ from distributed_tpu.shuffle.core import (
     ShuffleSpec,
     ShuffleWorkerExtension,
 )
+from distributed_tpu.shuffle.device import (
+    DeviceShuffleStore,
+    device_store,
+    p2p_shuffle_device,
+)
 from distributed_tpu.shuffle.scheduler_ext import ShuffleSchedulerExtension
 
 __all__ = [
     "p2p_shuffle",
     "p2p_shuffle_arrays",
+    "p2p_shuffle_device",
+    "DeviceShuffleStore",
+    "device_store",
     "p2p_rechunk",
     "p2p_merge",
     "p2p_merge_arrays",

@@ -1,0 +1,324 @@
+"""Device-resident P2P shuffle: shard movement over mesh collectives.
+
+The host engine (``shuffle.core``) moves every shard as msgpack frames
+over TCP/inproc — the right plane for host objects, the WRONG one for
+jax arrays already living on an accelerator mesh.  This module is the
+TPU-native analogue of the reference's UCX data plane
+(reference comm/ucx.py:211, frames carrying CUDA buffers :302-360):
+partitions stay on their devices; the exchange is ONE XLA all-to-all
+over the mesh interconnect (``ops.ici.shuffle_on_mesh``); the host RPC
+layer carries only control (run specs, epoch fencing, the barrier).
+
+Topology model: every participating worker owns one mesh device (the
+virtual 8-CPU mesh in tests; one chip per worker process on real pods).
+All workers live where the jax runtime can address the whole mesh — in
+a multi-host deployment that is exactly the ``jax.distributed`` SPMD
+model, where each host enters the same program with its local shards
+and XLA runs the collective across hosts; in the in-process test
+harness one execution covers every device and the results are shared
+through the process-level store.
+
+Flow (graph shapes mirror ``shuffle.api``):
+
+    transfer(i): REGISTER partition i's device arrays in the store —
+                 no splitting, no pushes, no serialization
+    barrier:     scheduler-fenced; the first arriving body executes the
+                 mesh exchange once per (id, run_id) epoch
+    unpack(j):   slice output shard j from the exchanged global arrays
+                 (device-resident; only the tiny counts vector touches
+                 the host, as control data)
+
+Epoch fencing rides the existing scheduler extension: a lost worker
+bumps ``run_id``, releasing the pipeline; stale registrations are
+dropped by (id, run_id) keying exactly like the host engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger("distributed_tpu.shuffle")
+
+
+class DeviceRun:
+    """Per-(id, run_id) device-shard registry + one-shot exchange."""
+
+    def __init__(self, id: str, run_id: int, n_inputs: int,
+                 npartitions_out: int):
+        self.id = id
+        self.run_id = run_id
+        self.n_inputs = n_inputs
+        self.npartitions_out = npartitions_out
+        self.parts: dict[int, tuple[Any, Any]] = {}
+        self.outputs: dict[int, tuple[Any, Any]] | None = None
+        self.served: set[int] = set()
+        self.lock = threading.Lock()
+
+    def register(self, pid: int, keys: Any, values: Any) -> None:
+        with self.lock:
+            self.parts[int(pid)] = (keys, values)
+
+    # ----------------------------------------------------------- exchange
+
+    def exchange(self) -> None:
+        """Run the mesh all-to-all once; idempotent per epoch.
+
+        Requires every partition registered (the barrier task's graph
+        dependencies guarantee it).  Partitions are placed one-per-device
+        on a 1-D mesh; ragged lengths are padded to a common local size
+        and masked out of the exchange (``valid``), so no padding row
+        ever crosses the interconnect as data.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_tpu.ops.ici import make_mesh_1d, shuffle_on_mesh
+
+        with self.lock:
+            if self.outputs is not None:
+                return
+            if len(self.parts) != self.n_inputs:
+                raise RuntimeError(
+                    f"device shuffle {self.id} run {self.run_id}: "
+                    f"{len(self.parts)}/{self.n_inputs} partitions registered"
+                )
+            n_dev = self.n_inputs
+            mesh = make_mesh_1d(n_dev)
+            devices = list(mesh.devices.flat)
+            max_n = max(int(k.shape[0]) for k, _ in self.parts.values())
+            max_n = max(max_n, 1)
+            val_shape = next(iter(self.parts.values()))[1].shape[1:]
+
+            k_shards, v_shards, m_shards = [], [], []
+            for d in range(n_dev):
+                keys, values = self.parts[d]
+                n = int(keys.shape[0])
+                keys = jnp.asarray(keys, jnp.int32)
+                pad = max_n - n
+                if pad:
+                    keys = jnp.concatenate(
+                        [keys, jnp.zeros(pad, jnp.int32)]
+                    )
+                    values = jnp.concatenate(
+                        [values,
+                         jnp.zeros((pad, *val_shape), values.dtype)]
+                    )
+                mask = jnp.arange(max_n) < n
+                # one-per-device placement: a partition produced on the
+                # right device moves nothing; a misplaced one pays one
+                # device-to-device copy, never a host serialization
+                k_shards.append(jax.device_put(keys, devices[d]))
+                v_shards.append(jax.device_put(values, devices[d]))
+                m_shards.append(jax.device_put(mask, devices[d]))
+
+            sharding = NamedSharding(mesh, P("shuffle"))
+            K = jax.make_array_from_single_device_arrays(
+                (n_dev * max_n,), sharding, k_shards
+            )
+            V = jax.make_array_from_single_device_arrays(
+                (n_dev * max_n, *val_shape), sharding, v_shards
+            )
+            M = jax.make_array_from_single_device_arrays(
+                (n_dev * max_n,), sharding, m_shards
+            )
+            # generous capacity: every row of one source could hash to
+            # the same destination
+            ko, vo, counts, _sent = shuffle_on_mesh(
+                mesh, K, V, capacity=max_n, valid=M
+            )
+            # counts are control data: the ONLY bytes that touch the host
+            cnt = np.asarray(counts).reshape(n_dev, n_dev)
+            if (cnt > max_n).any():  # pragma: no cover - capacity==max_n
+                raise RuntimeError("device shuffle truncated a block")
+
+            outputs: dict[int, tuple[Any, Any]] = {}
+            for d in range(n_dev):
+                # device d's receive buffers: rows [d*n_dev, (d+1)*n_dev)
+                kshard = ko.addressable_shards[d].data  # [n_dev, max_n]
+                vshard = vo.addressable_shards[d].data
+                kparts = [kshard[s, : int(cnt[d, s])] for s in range(n_dev)]
+                vparts = [vshard[s, : int(cnt[d, s])] for s in range(n_dev)]
+                outputs[d] = (
+                    jnp.concatenate(kparts) if kparts else kshard[:0],
+                    jnp.concatenate(vparts) if vparts else vshard[:0],
+                )
+            self.outputs = outputs
+
+
+class DeviceShuffleStore:
+    """Process-level registry of device runs (one jax runtime)."""
+
+    def __init__(self) -> None:
+        self.runs: dict[tuple[str, int], DeviceRun] = {}
+        # epochs fully served and collected: a straggling DUPLICATE task
+        # execution (steal race, speculative rerun) must not resurrect
+        # an empty run that would pin device memory forever
+        self.done: "deque[tuple[str, int]]" = deque(maxlen=256)
+        self._done_set: set[tuple[str, int]] = set()
+        self.lock = threading.Lock()
+
+    def get_or_create(self, id: str, run_id: int, n_inputs: int,
+                      npartitions_out: int) -> DeviceRun | None:
+        """The live run for this epoch, or None when the epoch already
+        completed (duplicate execution of a finished task)."""
+        with self.lock:
+            if (id, run_id) in self._done_set:
+                return None
+            run = self.runs.get((id, run_id))
+            if run is None:
+                run = self.runs[(id, run_id)] = DeviceRun(
+                    id, run_id, n_inputs, npartitions_out
+                )
+                # stale epochs of the same shuffle can be dropped
+                for key in [k for k in self.runs if k[0] == id and k[1] < run_id]:
+                    del self.runs[key]
+            return run
+
+    def forget(self, id: str) -> None:
+        with self.lock:
+            for key in [k for k in self.runs if k[0] == id]:
+                del self.runs[key]
+
+    def mark_served(self, run: DeviceRun, pid: int) -> None:
+        """Drop the run once every output partition was unpacked — the
+        results live in the worker data stores from then on, and keeping
+        the run would pin all inputs AND outputs in device memory for
+        the process lifetime.  A recomputed unpack (worker loss) arrives
+        under a BUMPED run_id and re-exchanges from fresh registrations."""
+        with self.lock:
+            run.served.add(int(pid))
+            # inputs are dead weight as soon as the exchange ran
+            run.parts.clear()
+            if len(run.served) >= run.npartitions_out:
+                self.runs.pop((run.id, run.run_id), None)
+                key = (run.id, run.run_id)
+                if key not in self._done_set:
+                    if len(self.done) == self.done.maxlen:
+                        self._done_set.discard(self.done[0])
+                    self.done.append(key)
+                    self._done_set.add(key)
+
+
+_store: DeviceShuffleStore | None = None
+
+
+def device_store() -> DeviceShuffleStore:
+    global _store
+    if _store is None:
+        _store = DeviceShuffleStore()
+    return _store
+
+
+# ------------------------------------------------------------ task bodies
+
+
+async def _spec_for(shuffle_id: str):
+    from distributed_tpu.worker.context import get_worker
+
+    worker = get_worker()
+    run = await worker.shuffle.get_or_create_remote(shuffle_id)
+    return worker, run
+
+
+async def device_shuffle_transfer(data: Any, shuffle_id: str,
+                                  partition_id: int) -> int:
+    """Register one device partition; zero data movement."""
+    worker, run = await _spec_for(shuffle_id)
+    keys, values = data
+    store_run = device_store().get_or_create(
+        shuffle_id, run.run_id, run.spec.npartitions_out,
+        run.spec.npartitions_out,
+    )
+    if store_run is not None:  # None: duplicate rerun of a finished epoch
+        store_run.register(partition_id, keys, values)
+    return partition_id
+
+
+async def device_shuffle_barrier(shuffle_id: str,
+                                 *transfer_results: int) -> int:
+    """Scheduler-fenced barrier, then the one-shot mesh exchange."""
+    worker, run = await _spec_for(shuffle_id)
+    await run.barrier()
+    store_run = device_store().get_or_create(
+        shuffle_id, run.run_id, run.spec.npartitions_out,
+        run.spec.npartitions_out,
+    )
+    if store_run is not None:  # None: duplicate rerun of a finished epoch
+        # the collective is a compile+execute: keep the event loop free
+        await asyncio.get_running_loop().run_in_executor(
+            None, store_run.exchange
+        )
+    return run.run_id
+
+
+async def device_shuffle_unpack(shuffle_id: str, partition_id: int,
+                                barrier_result: int) -> Any:
+    """Output partition j as device-resident (keys, values)."""
+    worker, run = await _spec_for(shuffle_id)
+    store_run = device_store().runs.get((shuffle_id, run.run_id))
+    if store_run is None or store_run.outputs is None:
+        # epoch raced past us (restart, or the run was already
+        # collected): ask for a fresh epoch and reschedule, like the
+        # host-engine bodies (shuffle/api.py _restart_and_reschedule)
+        from distributed_tpu.shuffle.api import _restart_and_reschedule
+
+        await _restart_and_reschedule(worker, shuffle_id, run.run_id)
+    out = store_run.outputs[int(partition_id)]
+    device_store().mark_served(store_run, partition_id)
+    return out
+
+
+# --------------------------------------------------------- graph builder
+
+
+async def p2p_shuffle_device(client: Any, inputs: list) -> list:
+    """Hash-shuffle device-resident (keys, values) partitions over the
+    mesh interconnect; returns futures of device-resident outputs.
+
+    ``inputs``: one future per mesh device, each resolving to
+    ``(keys i32[N_i], values [N_i, ...])`` jax arrays.  Output partition
+    d holds every row with ``murmur3(key) % n_devices == d``, resident
+    on mesh device d.
+    """
+    import uuid
+
+    from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+    from distributed_tpu.shuffle.api import _create_shuffle
+
+    n = len(inputs)
+    shuffle_id = f"devshuffle-{uuid.uuid4().hex[:12]}"
+    worker_for = await _create_shuffle(client, shuffle_id, n, n)
+
+    g = Graph()
+    transfer_keys = []
+    for i, fut in enumerate(inputs):
+        k = f"{shuffle_id}-transfer-{i}"
+        g.tasks[k] = TaskSpec(
+            device_shuffle_transfer, (TaskRef(fut.key), shuffle_id, i)
+        )
+        transfer_keys.append(k)
+    barrier_key = f"{shuffle_id}-barrier"
+    g.tasks[barrier_key] = TaskSpec(
+        device_shuffle_barrier,
+        (shuffle_id, *[TaskRef(k) for k in transfer_keys]),
+    )
+    unpack_keys = []
+    annotations = {}
+    for j in range(n):
+        k = f"{shuffle_id}-unpack-{j}"
+        g.tasks[k] = TaskSpec(
+            device_shuffle_unpack, (shuffle_id, j, TaskRef(barrier_key))
+        )
+        unpack_keys.append(k)
+        annotations[k] = {"workers": [worker_for[j]]}
+    futs = client._graph_to_futures(
+        dict(g.tasks), unpack_keys, annotations_by_key=annotations,
+    )
+    return [futs[k] for k in unpack_keys]

@@ -192,9 +192,18 @@ class Worker(Server):
             # rather than silently sampling nothing
             idents = None
             if hasattr(self.executor, "_threads"):
-                idents = lambda: [  # noqa: E731
-                    t.ident for t in self.executor._threads
-                ]
+
+                def idents() -> list:
+                    # the pool grows its _threads set concurrently with
+                    # submit(); retry the snapshot instead of letting a
+                    # transient RuntimeError kill this worker's profiling
+                    for _ in range(3):
+                        try:
+                            return [t.ident for t in self.executor._threads]
+                        except RuntimeError:
+                            continue
+                    return []
+
             self.profiler = Profiler(
                 thread_filter=self._exec_prefix,
                 idents=idents,
