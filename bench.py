@@ -241,6 +241,8 @@ async def _run_tensordot(jax_enabled, G=32):
                 if placement is not None:
                     placement.plan_hits = placement.plan_misses = 0
                     placement.plans_computed = 0
+                    for k in placement.miss_reasons:
+                        placement.miss_reasons[k] = 0
 
                 g, outs = _tensordot_graph(G)
                 n_tasks = len(g.tasks)
@@ -253,6 +255,7 @@ async def _run_tensordot(jax_enabled, G=32):
                         "plans": placement.plans_computed,
                         "hits": placement.plan_hits,
                         "misses": placement.plan_misses,
+                        "miss_reasons": dict(placement.miss_reasons),
                     }
                     if placement is not None
                     else None

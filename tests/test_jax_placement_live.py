@@ -112,8 +112,8 @@ async def test_plan_fallback_when_worker_dies():
             await victim.close(report=False)
             cluster.workers = cluster.workers[1:]
             assert all(
-                addr != victim.address
-                for addr, _ in placement.plan.values()
+                follow is not None or addr != victim.address
+                for follow, addr in placement.plan.values()
             )
             futs2 = c.map(inc, range(8), pure=False)
             assert await asyncio.wait_for(c.gather(futs2), 60) == list(
@@ -163,13 +163,13 @@ def test_hint_yields_to_idle_worker_unless_locality_pays():
     # tiny dep: waiting behind 10s of queue to save a 1-byte transfer is
     # absurd -> hint yields (miss), oracle will use the idle worker
     dep.nbytes = 1
-    placement.plan = {ts.key: (busy.address, dep.key)}
+    placement.plan = {ts.key: (dep.key, busy.address)}
     assert placement.decide_worker(state, ts, None) is None
     assert placement.plan_misses == 1
 
     # huge dep (100s at the configured bandwidth): locality beats the
     # 10s queue -> hint holds
     dep.nbytes = int(state.bandwidth * 100)
-    placement.plan = {ts.key: (busy.address, dep.key)}
+    placement.plan = {ts.key: (dep.key, busy.address)}
     assert placement.decide_worker(state, ts, None) is busy
     assert placement.plan_hits == 1
