@@ -91,6 +91,17 @@ async def run(args: argparse.Namespace) -> int:
     # match the scheduler's transport: a tls:// control plane means the
     # worker must serve its peers over tls too
     proto = args.scheduler.split("://", 1)[0] if "://" in args.scheduler else "tcp"
+    if (args.tls_ca_file or args.tls_cert) and proto == "tcp":
+        # mirror the scheduler CLI: supplying TLS credentials means an
+        # encrypted cluster — silently running the whole data plane in
+        # plaintext because the address said tcp:// is a foot-gun
+        rest = args.scheduler.split("://", 1)[-1]
+        args.scheduler = f"tls://{rest}"
+        proto = "tls"
+        logging.getLogger("distributed_tpu.cli").info(
+            "TLS credentials provided: scheduler address upgraded to %s",
+            args.scheduler,
+        )
     if host:
         listen_addr = f"{proto}://{host}:0"
     elif proto != "tcp":

@@ -328,9 +328,23 @@ class Client:
                             # the initiating client cancels its futures
                             # in restart() itself; its tagged echo must
                             # not cancel work submitted since (the
-                            # report stream is unordered with the rpc)
+                            # report stream is unordered with the rpc).
+                            # Other clients cancel exactly the keys the
+                            # scheduler snapshotted as theirs AT restart
+                            # time — futures whose submission the
+                            # scheduler processed after the restart are
+                            # alive and must survive the echo.
                             if msg.get("initiator") != self.id:
-                                for st in self.futures.values():
+                                keys = msg.get("keys")
+                                if keys is None:
+                                    targets = list(self.futures.values())
+                                else:
+                                    targets = [
+                                        st for k in keys
+                                        if (st := self.futures.get(k))
+                                        is not None
+                                    ]
+                                for st in targets:
                                     st.cancel()
                         if op != "restart":
                             return

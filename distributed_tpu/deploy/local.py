@@ -94,6 +94,12 @@ class LocalCluster:
         return Client(self.scheduler.address, security=self.security)
 
     async def close(self) -> None:
+        # flag shutdown BEFORE workers leave: per-departure recovery
+        # (shuffle epoch restarts) is noise once the whole cluster is
+        # going away.  A dedicated flag, NOT status=closing — flipping
+        # status would stop the comm loop from serving in-flight client
+        # RPCs during the drain window
+        self.scheduler.draining = True
         for worker in self.workers:
             await worker.close()
         self.workers.clear()

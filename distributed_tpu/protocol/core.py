@@ -107,15 +107,21 @@ def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryv
         # split big frames so no single read/write exceeds the shard size
         split_frames: list = []
         split_sizes: list[int] = []
+        uncompressed = 0
         for f in frames:
             mv = memoryview(f).cast("B") if not isinstance(f, bytes) else f
             n = memoryview(mv).nbytes
+            uncompressed += n
             if n > shard:
                 parts = [mv[i : i + shard] for i in range(0, n, shard)]
             else:
                 parts = [mv]
             split_frames.extend(parts)
             split_sizes.append(len(parts))
+        # true payload size BEFORE compression: opaque store-and-forward
+        # servers account nbytes from this, not from (possibly
+        # compressed) wire frames, so spill/rebalance see memory truth
+        head.setdefault("nbytes", uncompressed)
         head["path"] = list(path)
         head["frame-start"] = len(payload_frames)
         head["splits"] = split_sizes

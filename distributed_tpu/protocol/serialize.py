@@ -116,9 +116,13 @@ def wrap_opaque(obj: Any) -> Any:
 
 
 def payload_nbytes(obj: Any) -> int:
-    """Size estimate for accounting without deserializing: frame bytes
-    for opaque payloads, sizeof for live objects."""
+    """Size estimate for accounting without deserializing: the dumps-time
+    uncompressed size when the header recorded one (protocol/core.py),
+    frame bytes otherwise, sizeof for live objects."""
     if isinstance(obj, (Serialized, Pickled)):
+        n = obj.header.get("nbytes") if isinstance(obj.header, dict) else None
+        if n:
+            return int(n)
         return sum(
             len(f) if isinstance(f, (bytes, bytearray)) else f.nbytes
             for f in obj.frames

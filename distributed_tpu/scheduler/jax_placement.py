@@ -88,10 +88,16 @@ class JaxPlacement:
         self.plan_hits = 0
         self.plan_misses = 0
         self.plans_inflight = 0
-        # miss breakdown (diagnostics): why consulted hints were refused
+        # miss breakdown (diagnostics): why CONSULTED hints were refused
+        # (these partition plan_misses exactly)
         self.miss_reasons: dict[str, int] = {
             "worker-gone": 0, "restricted": 0, "dep-moved": 0,
-            "idle-yield": 0, "stale-dropped": 0, "landed-late": 0,
+            "idle-yield": 0,
+        }
+        # hints discarded WITHOUT being consulted (not misses): pruned
+        # as stale, or landed after the oracle had already placed them
+        self.hint_drops: dict[str, int] = {
+            "stale-dropped": 0, "landed-late": 0,
         }
         self.enabled = True
         self._executor: ThreadPoolExecutor | None = None
@@ -126,17 +132,27 @@ class JaxPlacement:
         follow_key, addr = entry
         if follow_key is not None:
             # locality hint: follow the chosen dependency to its LIVE
-            # location — robust to upstream drift by construction
+            # location — robust to upstream drift by construction; when
+            # the task is restricted, prefer a holder that satisfies the
+            # restriction over the first replica found
             dts = state.tasks.get(follow_key)
             ws = None
             if dts is not None and dts.who_has:
                 for cand in dts.who_has:
-                    if cand in state.running:
+                    if cand in state.running and (
+                        valid_workers is None or cand in valid_workers
+                    ):
                         ws = cand
                         break
             if ws is None:
                 self.plan_misses += 1
-                self.miss_reasons["dep-moved"] += 1
+                reason = (
+                    "restricted"
+                    if dts is not None
+                    and any(c in state.running for c in dts.who_has)
+                    else "dep-moved"
+                )
+                self.miss_reasons[reason] += 1
                 return None
         else:
             ws = state.workers.get(addr)
@@ -144,10 +160,10 @@ class JaxPlacement:
                 self.plan_misses += 1
                 self.miss_reasons["worker-gone"] += 1
                 return None
-        if valid_workers is not None and ws not in valid_workers:
-            self.plan_misses += 1
-            self.miss_reasons["restricted"] += 1
-            return None
+            if valid_workers is not None and ws not in valid_workers:
+                self.plan_misses += 1
+                self.miss_reasons["restricted"] += 1
+                return None
         if state.idle and ws.address not in state.idle:
             # The plan's wave model has drifted from live execution:
             # capacity sits idle while the hint targets a busy worker.
@@ -192,7 +208,7 @@ class JaxPlacement:
                 if (pts := state.tasks.get(k)) is not None
                 and pts.state in ("released", "waiting", "queued", "no-worker")
             }
-            self.miss_reasons["stale-dropped"] += before - len(self.plan)
+            self.hint_drops["stale-dropped"] += before - len(self.plan)
         # plan only runnable *pending* tasks whose dependencies are inside
         # the batch (external deps already sit on specific workers: the
         # python locality oracle is the right tool for those few), and
@@ -295,7 +311,7 @@ class JaxPlacement:
                 if (ts := state.tasks.get(k)) is not None
                 and ts.state in ("released", "waiting", "queued", "no-worker")
             }
-            self.miss_reasons["landed-late"] += len(plan) - len(live)
+            self.hint_drops["landed-late"] += len(plan) - len(live)
             if live:
                 self.plan.update(live)
                 self.plans_computed += 1

@@ -177,6 +177,7 @@ class Scheduler(Server):
         super().__init__(
             handlers=handlers, stream_handlers=stream_handlers, **server_kwargs
         )
+        self._close_begun = False
         self.extensions: dict[str, Any] = {}
         if extensions is None:
             extensions = default_extensions()
@@ -253,9 +254,13 @@ class Scheduler(Server):
         return self
 
     async def close(self, timeout: float | None = None) -> None:
-        if self.status in (Status.closed, Status.closing):
+        # status may already read "closing" (deploy layers flag shutdown
+        # before retiring workers so per-departure recovery stands down);
+        # only an actually-started close short-circuits
+        if self.status == Status.closed or self._close_begun:
             await self.finished()
             return
+        self._close_begun = True
         self.status = Status.closing
         logger.info("closing scheduler %s", self.id)
         for pc in self.periodic_callbacks.values():
@@ -844,13 +849,21 @@ class Scheduler(Server):
     async def replicate(self, keys: Iterable[Key] = (), n: int | None = None,
                         workers: list[str] | None = None, **kwargs: Any) -> None:
         """Copy keys onto additional workers (reference scheduler.py:6854)."""
-        if workers and not any(w in self.state.workers for w in workers):
-            # every requested target is unknown: error, don't silently
-            # fan the data out to the whole cluster instead
-            raise ValueError(
-                f"replicate: none of the requested workers are known: "
-                f"{sorted(workers)}"
-            )
+        if workers:
+            unknown = [w for w in workers if w not in self.state.workers]
+            if len(unknown) == len(workers):
+                # every requested target is unknown: error, don't
+                # silently fan the data out to the whole cluster instead
+                raise ValueError(
+                    f"replicate: none of the requested workers are known: "
+                    f"{sorted(workers)}"
+                )
+            if unknown:
+                # partial typo: replicate to the known subset but say so
+                # instead of silently dropping addresses
+                logger.warning(
+                    "replicate: ignoring unknown workers %s", sorted(unknown)
+                )
         candidates = [
             self.state.workers[w] for w in (workers or [])
             if w in self.state.workers
@@ -912,15 +925,39 @@ class Scheduler(Server):
         stimulus_id = seq_name("restart")
         for cs in list(self.state.clients.values()):
             if cs.client_key in self.client_comms:
+                # snapshot THIS client's wanted keys: its echo cancels
+                # exactly these — futures submitted after the restart was
+                # processed here (but before the unordered echo reached
+                # the client) must survive
                 self.client_comms[cs.client_key].send(
                     {"op": "restart", "stimulus_id": stimulus_id,
-                     "initiator": client}
+                     "initiator": client,
+                     "keys": [ts.key for ts in cs.wants_what]}
                 )
         for addr in list(self.state.workers):
             self.send_all({}, {addr: [{"op": "free-keys",
                                        "keys": list(self.state.tasks),
                                        "stimulus_id": stimulus_id}]})
         self.state._clear_task_state()
+        # workers under a nanny additionally CYCLE their process: the
+        # reference's restart clears worker-side module/memory state too
+        # (reference scheduler.py:6193 restart -> nanny.restart); bounded
+        # best-effort — a dead nanny must not wedge the restart
+        nannies = [
+            ws.extra["nanny"]
+            for ws in self.state.workers.values()
+            if ws.extra.get("nanny")
+        ]
+
+        async def _cycle(addr: str) -> None:
+            try:
+                await asyncio.wait_for(self.rpc(addr).restart(), 10)
+            except Exception:
+                logger.warning("nanny %s did not restart its worker", addr)
+
+        if nannies:
+            await asyncio.gather(*(_cycle(a) for a in nannies),
+                                 return_exceptions=True)
         return "OK"
 
     async def broadcast(self, msg: dict | None = None,

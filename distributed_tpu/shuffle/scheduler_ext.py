@@ -109,7 +109,10 @@ class ShuffleSchedulerExtension:
         return keys
 
     def _closing(self) -> bool:
-        return self.scheduler.status.name in ("closing", "closed")
+        return (
+            self.scheduler.status.name in ("closing", "closed")
+            or getattr(self.scheduler, "draining", False)
+        )
 
     def _request_restart(self, st: ShuffleState, reason: str) -> None:
         """Coalescing entry point for every restart cause (worker loss,

@@ -172,3 +172,33 @@ async def test_run_on_nanny_and_nanny_plugin():
                     await n2.close()
         finally:
             await nanny.close()
+
+
+@gen_test(timeout=180)
+async def test_scheduler_restart_cycles_nannied_worker():
+    """Scheduler.restart must also cycle worker processes under a nanny
+    (ADVICE r3: the reference's restart clears worker-side module and
+    memory state too, scheduler.py:6193 -> nanny restart)."""
+    async with Scheduler(validate=True) as s:
+        nanny = Nanny(s.address, nthreads=1, name="nanny-rc", env=CHILD_ENV)
+        async with nanny:
+            for _ in range(100):
+                if s.state.workers:
+                    break
+                await asyncio.sleep(0.1)
+            old_pid = nanny.process.pid
+            async with Client(s.address) as c:
+                assert await c.submit(lambda: 3, key="pre").result() == 3
+                await c.restart()
+                # the worker process must be REPLACED, and come back
+                for _ in range(300):
+                    if (
+                        nanny.process is not None
+                        and nanny.process.pid not in (None, old_pid)
+                        and s.state.workers
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                assert nanny.process.pid != old_pid, "worker not cycled"
+                fut = c.submit(lambda: 11, key="post", pure=False)
+                assert await asyncio.wait_for(fut.result(), 60) == 11
