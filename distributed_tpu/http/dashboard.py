@@ -78,6 +78,52 @@ def json_api_routes(scheduler: Any) -> dict[str, Callable]:
     async def fine_metrics() -> dict:
         return await scheduler.spans.get_fine_metrics()
 
+    async def profile() -> dict:
+        """Merged statistical profile of every worker's executor threads
+        as a call tree — the flame-graph data source (reference
+        dashboard profile components, scheduler.py:7991)."""
+        return await scheduler.get_profile()
+
+    def graph() -> dict:
+        """Task dependency graph, laid out in topological layers
+        (the role of reference diagnostics/graph_layout.py:9): nodes
+        carry (key, state, layer); edges are (src, dst) index pairs.
+        Bounded to the newest 600 tasks so the page stays light on
+        million-task schedulers."""
+        state = scheduler.state
+        tasks = list(state.tasks.values())[-600:]
+        index = {ts.key: i for i, ts in enumerate(tasks)}
+        depth: dict[str, int] = {}
+
+        def layer(ts) -> int:
+            d = depth.get(ts.key)
+            if d is not None:
+                return d
+            depth[ts.key] = 0  # cycle guard
+            d = 0
+            for dts in ts.dependencies:
+                if dts.key in index:
+                    d = max(d, layer(dts) + 1)
+            depth[ts.key] = d
+            return d
+
+        nodes = [
+            {
+                "key": ts.key,
+                "state": ts.state,
+                "layer": layer(ts),
+                "prefix": ts.prefix.name if ts.prefix else "",
+            }
+            for ts in tasks
+        ]
+        edges = [
+            [index[dts.key], i]
+            for i, ts in enumerate(tasks)
+            for dts in ts.dependencies
+            if dts.key in index
+        ]
+        return {"nodes": nodes, "edges": edges}
+
     return {
         "/api/v1/workers": workers,
         "/api/v1/tasks": tasks,
@@ -85,6 +131,8 @@ def json_api_routes(scheduler: Any) -> dict[str, Callable]:
         "/api/v1/memory": memory,
         "/api/v1/spans": spans,
         "/api/v1/fine_metrics": fine_metrics,
+        "/api/v1/profile": profile,
+        "/api/v1/graph": graph,
         "/dashboard": lambda: (DASHBOARD_HTML, "text/html; charset=utf-8"),
     }
 
@@ -111,6 +159,12 @@ DASHBOARD_HTML = """<!doctype html>
 <section><b>Workers</b><div id=workers></div></section>
 <section><b>Memory</b><svg id=mem height=120 viewBox="0 0 1000 120"
   preserveAspectRatio="none"></svg></section>
+<section><b>Task graph</b> <span class=muted>(newest 600 tasks, layered
+ by dependency depth)</span><svg id=graph height=220 viewBox="0 0 1000 220"
+  preserveAspectRatio="none"></svg></section>
+<section><b>Profile</b> <span class=muted>(merged executor flame graph;
+ click to refresh)</span><svg id=flame height=200 viewBox="0 0 1000 200"
+  onclick="drawFlame()"></svg></section>
 <script>
 const colors={};let hue=0;
 function color(n){if(!(n in colors)){colors[n]=`hsl(${(hue=hue+67)%360} 60% 55%)`}return colors[n]}
@@ -159,6 +213,46 @@ async function tick(){
  }catch(e){document.getElementById('meta').textContent='disconnected: '+e}
  setTimeout(tick,1000);
 }
-tick();
+const stateColor={memory:'#4cd67c',processing:'#4c8dd6',waiting:'#c9b458',
+  queued:'#888',released:'#555',erred:'#d64c4c','no-worker':'#d68d4c'};
+async function drawGraph(){
+ try{
+  const g=await j('/api/v1/graph');
+  const byLayer={};g.nodes.forEach((n,i)=>{(byLayer[n.layer]=byLayer[n.layer]||[]).push(i)});
+  const L=Object.keys(byLayer).length||1;const pos=[];
+  for(const[l,idxs]of Object.entries(byLayer)){
+   idxs.forEach((i,k)=>{pos[i]=[(k+0.5)*1000/idxs.length,(+l+0.5)*220/L]})}
+  let out='';
+  for(const[a,b]of g.edges){const[x1,y1]=pos[a],[x2,y2]=pos[b];
+   out+=`<line x1="${x1}" y1="${y1}" x2="${x2}" y2="${y2}" stroke="#333"/>`}
+  g.nodes.forEach((n,i)=>{const[x,y]=pos[i];
+   out+=`<circle cx="${x}" cy="${y}" r="4" fill="${stateColor[n.state]||'#777'}"><title>${n.key} (${n.state})</title></circle>`});
+  document.getElementById('graph').innerHTML=out;
+ }catch(e){}
+ setTimeout(drawGraph,3000);
+}
+async function drawFlame(){
+ try{
+  const root=await j('/api/v1/profile');
+  let out='';const H=200,rh=18;
+  function rec(node,x,w,d){
+   if(d*rh>H-rh||w<1)return;
+   const kids=Object.values(node.children||{});
+   const total=kids.reduce((s,c)=>s+c.count,0)||1;
+   let cx=x;
+   for(const c of kids){
+    const cw=w*(c.count/Math.max(node.count,total));
+    const label=(c.description||c.identifier||'').split(';')[0];
+    out+=`<rect x="${cx}" y="${d*rh}" width="${Math.max(cw-1,0.5)}" height="${rh-2}"
+      fill="${color(label)}"><title>${c.identifier} — ${c.count} samples</title></rect>`;
+    if(cw>60)out+=`<text x="${cx+3}" y="${d*rh+12}" font-size="10" fill="#000">${label.slice(0,Math.floor(cw/7))}</text>`;
+    rec(c,cx,cw,d+1);cx+=cw}
+  }
+  if(root&&root.count){rec(root,0,1000,0)}
+  else{out='<text x="10" y="20" fill="#888" font-size="12">no samples yet — run some tasks</text>'}
+  document.getElementById('flame').innerHTML=out;
+ }catch(e){}
+}
+tick();drawGraph();drawFlame();setInterval(drawFlame,5000);
 </script></body></html>
 """

@@ -1,0 +1,88 @@
+"""Probabilistic chaos soak (the role of reference tests/test_chaos.py,
+test_stress.py): kill workers on a random clock under sustained load and
+require full, correct completion with a quiescent scheduler at the end.
+The deterministic race harness pins known interleavings; this layer
+hunts the unknown ones."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from distributed_tpu import config
+from distributed_tpu.client.client import Client
+from distributed_tpu.deploy.local import LocalCluster
+
+from conftest import gen_test
+
+
+def _inc(x):
+    return x + 1
+
+
+def _tree_sum(xs):
+    return sum(xs)
+
+
+@gen_test(timeout=280)
+async def test_chaos_kill_workers_under_load():
+    """5k-task workload while a KillWorker chaos clock (exponential,
+    mean ~0.8 s) closes a random worker and replaces it.  Done means:
+    every result correct, no stuck tasks, scheduler quiescent."""
+    rng = random.Random(42)
+    n_tasks = 5000
+    with config.set({
+        "scheduler.allowed-failures": 100,  # deaths are the POINT here
+        "scheduler.jax.enabled": False,
+    }):
+        async with LocalCluster(
+            n_workers=8, threads_per_worker=1,
+            scheduler_kwargs={"validate": True},
+            worker_kwargs={"validate": True},
+        ) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                stop = asyncio.Event()
+                kills = 0
+
+                async def chaos():
+                    nonlocal kills
+                    while not stop.is_set():
+                        try:
+                            await asyncio.wait_for(
+                                stop.wait(), rng.expovariate(1 / 0.8)
+                            )
+                            return
+                        except asyncio.TimeoutError:
+                            pass
+                        if len(cluster.workers) <= 2:
+                            continue
+                        victim = rng.choice(cluster.workers)
+                        cluster.workers.remove(victim)
+                        await victim.close(report=False)
+                        kills += 1
+                        await cluster.add_worker(
+                            name=f"chaos-replacement-{kills}"
+                        )
+
+                chaos_task = asyncio.create_task(chaos())
+                try:
+                    futs = c.map(_inc, range(n_tasks))
+                    # a reduction layer so the chaos also hits tasks
+                    # with dependencies (lost-replica recompute paths)
+                    sums = [
+                        c.submit(_tree_sum, futs[i : i + 50],
+                                 key=f"chaos-sum-{i}")
+                        for i in range(0, n_tasks, 50)
+                    ]
+                    total = await asyncio.wait_for(
+                        c.gather(c.submit(_tree_sum, sums)), 240
+                    )
+                finally:
+                    stop.set()
+                    await chaos_task
+                assert total == sum(range(1, n_tasks + 1)), total
+                assert kills >= 3, f"chaos too tame: {kills} kills"
+                # quiescence: nothing processing or queued once done
+                s = cluster.scheduler
+                for ts in s.state.tasks.values():
+                    assert ts.state in ("memory", "released", "forgotten"), ts

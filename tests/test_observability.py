@@ -230,3 +230,64 @@ async def test_progress_bar_tracks_futures():
                 buf = io.StringIO()
                 await asyncio.wait_for(progress(bad, file=buf), 30)
                 assert "2 erred" in buf.getvalue()
+
+
+@gen_test(timeout=120)
+async def test_dashboard_profile_and_graph_routes():
+    """Dashboard-lite round 4: /api/v1/profile serves the merged worker
+    flame-graph call tree and /api/v1/graph a layered dependency graph;
+    the HTML page embeds renderers for both (reference
+    dashboard/components/scheduler.py profile + graph components,
+    diagnostics/graph_layout.py:9)."""
+    import json
+    import time as _time
+    import urllib.request
+
+    from distributed_tpu import config
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+
+    def work(i):
+        _time.sleep(0.03)
+        return sum(range(50_000)) + i
+
+    with config.set({"worker.profile.enabled": True}):
+        async with LocalCluster(
+            n_workers=2, scheduler_kwargs={"http_port": 0}
+        ) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                a = [c.submit(work, i, key=f"ga-{i}") for i in range(8)]
+                b = [
+                    c.submit(lambda x, y: x + y, a[i], a[i + 1],
+                             key=f"gb-{i}")
+                    for i in range(0, 6, 2)
+                ]
+                await c.gather(b)
+                port = cluster.scheduler.http_server.port
+                loop = asyncio.get_running_loop()
+
+                def get(p):
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{p}"
+                    ) as r:
+                        return json.loads(r.read())
+
+                g = await loop.run_in_executor(None, get, "/api/v1/graph")
+                assert g["nodes"] and g["edges"]
+                for src, dst in g["edges"]:
+                    assert g["nodes"][src]["layer"] < g["nodes"][dst]["layer"]
+                prof = await loop.run_in_executor(
+                    None, get, "/api/v1/profile"
+                )
+                assert "count" in prof and "children" in prof
+
+                def fetch_html():
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/dashboard"
+                    ) as r:
+                        return r.read().decode()
+
+                html = await loop.run_in_executor(None, fetch_html)
+                for needle in ("drawGraph", "drawFlame",
+                               "/api/v1/graph", "/api/v1/profile"):
+                    assert needle in html, needle

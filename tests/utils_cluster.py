@@ -13,6 +13,9 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import os
+import threading
+import time as _time
 from typing import Any
 
 import pytest
@@ -21,6 +24,64 @@ from distributed_tpu import config
 from distributed_tpu.client.client import Client
 from distributed_tpu.scheduler.server import Scheduler
 from distributed_tpu.worker.server import Worker
+
+# thread-name prefixes a finished cluster must not leave behind
+_OWNED_THREAD_PREFIXES = ("dtpu-worker-exec",)
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # pragma: no cover - non-linux
+        return 0
+
+
+def _owned_threads() -> set[int]:
+    return {
+        t.ident
+        for t in threading.enumerate()
+        if t.ident is not None
+        and any(t.name.startswith(p) for p in _OWNED_THREAD_PREFIXES)
+    }
+
+
+async def assert_no_cluster_leaks(fds_before: int,
+                                  threads_before: set[int] | None = None,
+                                  fd_slack: int = 8) -> None:
+    """Post-teardown leak oracle (the role of reference
+    pytest_resourceleaks.py): executor threads gone, no stray asyncio
+    tasks beyond the current one, fd count back to ~baseline.  Retries
+    with a grace window — closes are asynchronous.  Only threads CREATED
+    since ``threads_before`` count: an earlier opted-out test may have
+    parked an unkillable blocked body in its executor."""
+    threads_before = threads_before or set()
+    deadline = _time.monotonic() + 5.0
+    current = asyncio.current_task()
+    while True:
+        import gc
+
+        threads = [
+            t.name
+            for t in threading.enumerate()
+            if t.ident is not None and t.ident not in threads_before
+            and any(t.name.startswith(p) for p in _OWNED_THREAD_PREFIXES)
+        ]
+        tasks = [
+            t for t in asyncio.all_tasks()
+            if t is not current and not t.done()
+        ]
+        gc.collect()
+        fds = _fd_count()
+        ok = not threads and not tasks and fds <= fds_before + fd_slack
+        if ok:
+            return
+        if _time.monotonic() > deadline:
+            assert not threads, f"leaked executor threads: {threads}"
+            assert not tasks, f"leaked asyncio tasks: {tasks[:5]}"
+            assert fds <= fds_before + fd_slack, (
+                f"leaked fds: {fds} now vs {fds_before} before"
+            )
+        await asyncio.sleep(0.05)
 
 
 def gen_cluster(
@@ -32,6 +93,7 @@ def gen_cluster(
     worker_kwargs: dict | None = None,
     config_overrides: dict | None = None,
     transports: tuple[str, ...] = ("inproc",),
+    leak_check: bool = True,
 ):
     """Decorator: run ``fn(c, s, *workers)`` (or ``fn(s, *workers)`` with
     ``client=False``) on a fresh cluster per listed transport."""
@@ -46,6 +108,8 @@ def gen_cluster(
         @pytest.mark.parametrize("transport", list(transports))
         def wrapper(transport):
             async def run():
+                fds_before = _fd_count()
+                threads_before = _owned_threads()
                 overrides = {
                     "scheduler.jax.enabled": False,
                     **(config_overrides or {}),
@@ -85,6 +149,12 @@ def gen_cluster(
                             except Exception:
                                 pass
                         await s.close()
+                # leak oracle ON BY DEFAULT for every gen_cluster test;
+                # leak_check=False is for tests that deliberately park
+                # blocked user code in executor threads (python offers
+                # no way to kill a thread, so those outlive the cluster)
+                if leak_check:
+                    await assert_no_cluster_leaks(fds_before, threads_before)
 
             asyncio.run(run())
 
