@@ -168,6 +168,10 @@ DASHBOARD_HTML = """<!doctype html>
 <script>
 const colors={};let hue=0;
 function color(n){if(!(n in colors)){colors[n]=`hsl(${(hue=hue+67)%360} 60% 55%)`}return colors[n]}
+// task keys / identifiers come from user graphs: escape before any
+// innerHTML/SVG string-build or a key containing markup is stored XSS
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+  .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
 async function j(p){const r=await fetch(p);return r.json()}
 async function tick(){
  try{
@@ -177,13 +181,13 @@ async function tick(){
   document.getElementById('meta').textContent=
     `${ws.length} workers · ${ts.total} tasks`;
   document.getElementById('states').innerHTML=Object.entries(ts.by_state)
-    .map(([s,n])=>`<span class=state><span class=dot style="background:${color(s)}"></span>${s}: ${n}</span>`).join('');
+    .map(([s,n])=>`<span class=state><span class=dot style="background:${color(s)}"></span>${esc(s)}: ${n}</span>`).join('');
   // workers table
-  const rows=ws.map(w=>`<tr><td>${w.name}</td><td>${w.address}</td>
+  const rows=ws.map(w=>`<tr><td>${esc(w.name)}</td><td>${esc(w.address)}</td>
     <td class=num>${w.nthreads}</td><td class=num>${w.processing}</td>
     <td class=num>${w.stored}</td>
     <td class=num>${(w.managed_bytes/1e6).toFixed(1)} MB</td>
-    <td class=num>${w.occupancy}</td><td>${w.status}</td></tr>`).join('');
+    <td class=num>${w.occupancy}</td><td>${esc(w.status)}</td></tr>`).join('');
   document.getElementById('workers').innerHTML=
     `<table><tr><th>name</th><th>address</th><th>threads</th><th>proc</th>
      <th>stored</th><th>managed</th><th>occupancy</th><th>status</th></tr>${rows}</table>`;
@@ -200,15 +204,15 @@ async function tick(){
     for(const ss of r.startstops||[]){
      const x=(ss.start-t0)*sx,w=Math.max(1,(ss.stop-ss.start)*sx);
      bars+=`<rect x="${x}" y="${y+1}" width="${w}" height="${rh-2}"
-       fill="${r.error?'#d64c4c':color(r.name)}"><title>${r.key}</title></rect>`}}}
+       fill="${r.error?'#d64c4c':color(r.name)}"><title>${esc(r.key)}</title></rect>`}}}
   svg.innerHTML=bars;
   // memory per worker
   const names=Object.keys(mem.workers);const bw=1000/Math.max(names.length,1);
   let mx=1;for(const n of names){mx=Math.max(mx,mem.workers[n].rss||mem.workers[n].managed)}
   let mbars='';names.forEach((n,i)=>{const m=mem.workers[n];
     const h1=110*(m.managed/mx),h2=110*((m.rss||0)/mx);
-    mbars+=`<rect x="${i*bw+2}" width="${bw*0.4}" y="${115-h1}" height="${h1}" fill="#4c8dd6"><title>${n} managed</title></rect>
-            <rect x="${i*bw+2+bw*0.45}" width="${bw*0.4}" y="${115-h2}" height="${h2}" fill="#8d6cd6"><title>${n} rss</title></rect>`});
+    mbars+=`<rect x="${i*bw+2}" width="${bw*0.4}" y="${115-h1}" height="${h1}" fill="#4c8dd6"><title>${esc(n)} managed</title></rect>
+            <rect x="${i*bw+2+bw*0.45}" width="${bw*0.4}" y="${115-h2}" height="${h2}" fill="#8d6cd6"><title>${esc(n)} rss</title></rect>`});
   document.getElementById('mem').innerHTML=mbars;
  }catch(e){document.getElementById('meta').textContent='disconnected: '+e}
  setTimeout(tick,1000);
@@ -226,7 +230,7 @@ async function drawGraph(){
   for(const[a,b]of g.edges){const[x1,y1]=pos[a],[x2,y2]=pos[b];
    out+=`<line x1="${x1}" y1="${y1}" x2="${x2}" y2="${y2}" stroke="#333"/>`}
   g.nodes.forEach((n,i)=>{const[x,y]=pos[i];
-   out+=`<circle cx="${x}" cy="${y}" r="4" fill="${stateColor[n.state]||'#777'}"><title>${n.key} (${n.state})</title></circle>`});
+   out+=`<circle cx="${x}" cy="${y}" r="4" fill="${stateColor[n.state]||'#777'}"><title>${esc(n.key)} (${esc(n.state)})</title></circle>`});
   document.getElementById('graph').innerHTML=out;
  }catch(e){}
  setTimeout(drawGraph,3000);
@@ -244,8 +248,8 @@ async function drawFlame(){
     const cw=w*(c.count/Math.max(node.count,total));
     const label=(c.description||c.identifier||'').split(';')[0];
     out+=`<rect x="${cx}" y="${d*rh}" width="${Math.max(cw-1,0.5)}" height="${rh-2}"
-      fill="${color(label)}"><title>${c.identifier} — ${c.count} samples</title></rect>`;
-    if(cw>60)out+=`<text x="${cx+3}" y="${d*rh+12}" font-size="10" fill="#000">${label.slice(0,Math.floor(cw/7))}</text>`;
+      fill="${color(label)}"><title>${esc(c.identifier)} — ${c.count} samples</title></rect>`;
+    if(cw>60)out+=`<text x="${cx+3}" y="${d*rh+12}" font-size="10" fill="#000">${esc(label.slice(0,Math.floor(cw/7)))}</text>`;
     rec(c,cx,cw,d+1);cx+=cw}
   }
   if(root&&root.count){rec(root,0,1000,0)}

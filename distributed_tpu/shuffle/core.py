@@ -54,13 +54,18 @@ class ShuffleSpec:
     shuffle/_core.py:421).  Created by the scheduler extension; run_id is
     the fencing epoch."""
 
-    __slots__ = ("id", "run_id", "npartitions_out", "worker_for")
+    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for")
 
     def __init__(self, id: str, run_id: int, npartitions_out: int,
-                 worker_for: dict[int, str]):
+                 worker_for: dict[int, str], n_inputs: int | None = None):
         self.id = id
         self.run_id = run_id
         self.npartitions_out = npartitions_out
+        # input-partition count is independent of the output fan-out
+        # (n_in != n_out shuffles); consumers that need "how many
+        # registrations complete the exchange" must use this, never
+        # npartitions_out
+        self.n_inputs = n_inputs if n_inputs is not None else npartitions_out
         self.worker_for = dict(worker_for)
 
     @property
@@ -72,6 +77,7 @@ class ShuffleSpec:
             "id": self.id,
             "run_id": self.run_id,
             "npartitions_out": self.npartitions_out,
+            "n_inputs": self.n_inputs,
             "worker_for": {str(k): v for k, v in self.worker_for.items()},
         }
 
@@ -80,6 +86,7 @@ class ShuffleSpec:
         return cls(
             msg["id"], msg["run_id"], msg["npartitions_out"],
             {int(k): v for k, v in msg["worker_for"].items()},
+            n_inputs=msg.get("n_inputs"),
         )
 
 
@@ -395,6 +402,15 @@ class ShuffleWorkerExtension:
             if (run.local_outputs_left <= 0 and idle >= 5.0) or idle >= self.RUN_TTL:
                 run.close()
                 del self.runs[id]
+                # collect any device-resident run of this epoch too:
+                # abandoned epochs must not pin device arrays.  Idle-gated
+                # because the device store is process-global while this
+                # cleanup fires off ONE worker's host-run idleness — a
+                # live exchange other workers are unpacking stays.
+                from distributed_tpu.shuffle.device import device_store
+
+                device_store().forget(id, run_id,
+                                      only_idle_for=self.RUN_TTL)
             else:
                 self.schedule_cleanup(
                     id, run_id, delay=max(self.RUN_TTL - idle, 5.0)

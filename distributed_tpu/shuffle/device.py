@@ -38,6 +38,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time as _time
 from collections import deque
 from typing import Any
 
@@ -58,10 +59,15 @@ class DeviceRun:
         self.parts: dict[int, tuple[Any, Any]] = {}
         self.outputs: dict[int, tuple[Any, Any]] | None = None
         self.served: set[int] = set()
+        self.last_activity = _time.monotonic()
         self.lock = threading.Lock()
+
+    def touch(self) -> None:
+        self.last_activity = _time.monotonic()
 
     def register(self, pid: int, keys: Any, values: Any) -> None:
         with self.lock:
+            self.touch()
             self.parts[int(pid)] = (keys, values)
 
     # ----------------------------------------------------------- exchange
@@ -82,6 +88,7 @@ class DeviceRun:
         from distributed_tpu.ops.ici import make_mesh_1d, shuffle_on_mesh
 
         with self.lock:
+            self.touch()
             if self.outputs is not None:
                 return
             if len(self.parts) != self.n_inputs:
@@ -162,28 +169,79 @@ class DeviceShuffleStore:
         # an empty run that would pin device memory forever
         self.done: "deque[tuple[str, int]]" = deque(maxlen=256)
         self._done_set: set[tuple[str, int]] = set()
+        # newest epoch ever seen per shuffle id: a straggling registration
+        # carrying an OLDER run_id (fetched just before a restart bump)
+        # must not re-create a dead epoch and pin its input arrays.
+        # Bounded (insertion-ordered eviction) — shuffle ids are fresh
+        # uuids, so without a cap this grows for the process lifetime.
+        self._max_run: dict[str, int] = {}
+        self._max_run_cap = 4096
+        # served epochs that already absorbed ONE duplicate-unpack
+        # reschedule: a second miss means the output is genuinely gone
+        # (not a steal-race duplicate) and must restart the epoch
+        self._served_rescheduled: set[tuple[str, int, int]] = set()
         self.lock = threading.Lock()
 
     def get_or_create(self, id: str, run_id: int, n_inputs: int,
                       npartitions_out: int) -> DeviceRun | None:
         """The live run for this epoch, or None when the epoch already
-        completed (duplicate execution of a finished task)."""
+        completed (duplicate execution of a finished task) or was
+        superseded by a newer epoch (straggler with a stale run_id)."""
         with self.lock:
             if (id, run_id) in self._done_set:
+                return None
+            if run_id < self._max_run.get(id, -1):
                 return None
             run = self.runs.get((id, run_id))
             if run is None:
                 run = self.runs[(id, run_id)] = DeviceRun(
                     id, run_id, n_inputs, npartitions_out
                 )
+                self._max_run.pop(id, None)  # re-insert at newest position
+                self._max_run[id] = run_id
+                while len(self._max_run) > self._max_run_cap:
+                    del self._max_run[next(iter(self._max_run))]
                 # stale epochs of the same shuffle can be dropped
                 for key in [k for k in self.runs if k[0] == id and k[1] < run_id]:
                     del self.runs[key]
             return run
 
-    def forget(self, id: str) -> None:
+    def was_served_once(self, id: str, run_id: int, pid: int) -> bool:
+        """True the FIRST time a finished-and-collected epoch sees a
+        duplicate unpack of partition ``pid`` — the cheap reschedule
+        path.  A second miss for the same partition means the unpacked
+        output was genuinely lost afterwards (eviction, worker death
+        without an epoch bump): the caller must restart the epoch, or a
+        bare reschedule would livelock forever."""
         with self.lock:
-            for key in [k for k in self.runs if k[0] == id]:
+            if (id, run_id) not in self._done_set:
+                return False
+            tag = (id, run_id, int(pid))
+            if tag in self._served_rescheduled:
+                return False
+            self._served_rescheduled.add(tag)
+            return True
+
+    def forget(self, id: str, run_id: int | None = None,
+               only_idle_for: float | None = None) -> None:
+        """Collect device runs of ``id`` (all epochs, or only epochs
+        <= ``run_id``).  Wired into the worker extension's run-TTL
+        cleanup so abandoned epochs don't pin device arrays.
+
+        ``only_idle_for``: skip runs touched more recently than this many
+        seconds.  The TTL cleanup fires per-WORKER off one worker's host
+        run going idle, but the device store is process-global: a
+        transfer-only worker's 5s-idle cleanup must not collect an
+        exchange other in-process workers are still unpacking.
+        """
+        now = _time.monotonic()
+        with self.lock:
+            for key in [
+                k for k, r in self.runs.items()
+                if k[0] == id and (run_id is None or k[1] <= run_id)
+                and (only_idle_for is None
+                     or now - r.last_activity >= only_idle_for)
+            ]:
                 del self.runs[key]
 
     def mark_served(self, run: DeviceRun, pid: int) -> None:
@@ -193,6 +251,7 @@ class DeviceShuffleStore:
         the process lifetime.  A recomputed unpack (worker loss) arrives
         under a BUMPED run_id and re-exchanges from fresh registrations."""
         with self.lock:
+            run.touch()
             run.served.add(int(pid))
             # inputs are dead weight as soon as the exchange ran
             run.parts.clear()
@@ -233,7 +292,7 @@ async def device_shuffle_transfer(data: Any, shuffle_id: str,
     worker, run = await _spec_for(shuffle_id)
     keys, values = data
     store_run = device_store().get_or_create(
-        shuffle_id, run.run_id, run.spec.npartitions_out,
+        shuffle_id, run.run_id, run.spec.n_inputs,
         run.spec.npartitions_out,
     )
     if store_run is not None:  # None: duplicate rerun of a finished epoch
@@ -247,7 +306,7 @@ async def device_shuffle_barrier(shuffle_id: str,
     worker, run = await _spec_for(shuffle_id)
     await run.barrier()
     store_run = device_store().get_or_create(
-        shuffle_id, run.run_id, run.spec.npartitions_out,
+        shuffle_id, run.run_id, run.spec.n_inputs,
         run.spec.npartitions_out,
     )
     if store_run is not None:  # None: duplicate rerun of a finished epoch
@@ -261,9 +320,22 @@ async def device_shuffle_barrier(shuffle_id: str,
 async def device_shuffle_unpack(shuffle_id: str, partition_id: int,
                                 barrier_result: int) -> Any:
     """Output partition j as device-resident (keys, values)."""
+    from distributed_tpu.exceptions import Reschedule
+
     worker, run = await _spec_for(shuffle_id)
     store_run = device_store().runs.get((shuffle_id, run.run_id))
     if store_run is None or store_run.outputs is None:
+        if device_store().was_served_once(shuffle_id, run.run_id,
+                                          partition_id):
+            # duplicate execution of a FINISHED epoch (steal race,
+            # speculative rerun): every output already sits in worker
+            # memory — rescheduling is enough; a shuffle_restart RPC
+            # here would re-run the whole completed shuffle.  Once only:
+            # a SECOND miss for this partition means the output really
+            # vanished and the restart path below must run.
+            raise Reschedule(
+                f"shuffle {shuffle_id} run {run.run_id} already served"
+            )
         # epoch raced past us (restart, or the run was already
         # collected): ask for a fresh epoch and reschedule, like the
         # host-engine bodies (shuffle/api.py _restart_and_reschedule)

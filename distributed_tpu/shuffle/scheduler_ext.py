@@ -56,6 +56,7 @@ class ShuffleState:
             "id": self.id,
             "run_id": self.run_id,
             "npartitions_out": self.npartitions_out,
+            "n_inputs": self.n_inputs,
             "worker_for": {str(k): v for k, v in self.worker_for.items()},
         }
 
