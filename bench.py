@@ -726,6 +726,55 @@ def probe_backend(env):
     return None, last_err
 
 
+# per-config scalar that must not get worse round-over-round
+# (name, key, higher_is_better)
+_GATE_METRICS = {
+    "array_sum": ("overhead_us_per_task", False),
+    "rechunk_tensordot": ("wall_s", False),
+    "steal": ("wall_s", False),
+    "shuffle": ("rows_per_s", True),
+    "dag_1m": ("wall_s", False),
+}
+
+
+def _regression_gate(configs: dict) -> None:
+    """WARN (stderr) when any config is >20% worse than the newest
+    committed BENCH_r*.json — the round-4 config-1 regression shipped
+    unnoticed because nothing compared rounds."""
+    import glob
+    import re
+
+    # advisory only: NOTHING in here may kill the run (the headline JSON
+    # line must always print — the round-2 rc=1 lesson above)
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = []
+        for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+            m = re.search(r"r(\d+)", os.path.basename(p))
+            if m:
+                candidates.append((int(m.group(1)), p))
+        if not candidates:
+            return
+        with open(max(candidates)[1]) as f:
+            prev = json.load(f).get("parsed", {}).get("configs", {})
+    except Exception:
+        return
+    try:
+        for name, (key, higher) in _GATE_METRICS.items():
+            old = (prev.get(name) or {}).get(key)
+            new = (configs.get(name) or {}).get(key)
+            if not old or not new:
+                continue
+            ratio = (old / new) if higher else (new / old)
+            if ratio > 1.2:
+                sys.stderr.write(
+                    f"WARN: regression gate: {name}.{key} {old} -> {new} "
+                    f"({ratio:.2f}x worse than previous round)\n"
+                )
+    except Exception:
+        return
+
+
 def main():
     t_start = time.perf_counter()
     cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -788,6 +837,8 @@ def main():
                 )
         except Exception as exc:
             errors["dag_1m_cpu_retry"] = str(exc)[:400]
+
+    _regression_gate(configs)
 
     dag = configs.get("dag_1m")
     headline = {

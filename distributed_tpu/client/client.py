@@ -181,7 +181,7 @@ class Client:
         self.refcount: dict[Key, int] = {}
         self._cancel_expected: dict[Key, "FutureState"] = {}
         self.scheduler_comm: Comm | None = None
-        self.batched_stream = BatchedSend(interval=0.002)
+        self.batched_stream = BatchedSend()
         self.scheduler: rpc | None = None
         self.status = "newly-created"
         self.asynchronous = asynchronous

@@ -3,8 +3,10 @@
 Reference batched.py:20 — the scheduler<->worker and scheduler<->client
 event streams each push hundreds of tiny dicts per second; sending each in
 its own write would syscall-storm.  ``send()`` appends to a buffer; a
-background loop flushes the whole buffer as one list every ``interval``
-(2-5 ms), waiting for the comm between flushes.
+background loop flushes the whole buffer as one list the moment it wakes.
+Coalescing comes from messages accumulating while the previous
+``comm.write`` awaits — there is deliberately NO timed window: any sleep
+here sits inside every scheduler<->worker round trip.
 """
 
 from __future__ import annotations
@@ -21,8 +23,7 @@ logger = logging.getLogger("distributed_tpu.rpc")
 
 
 class BatchedSend:
-    def __init__(self, interval: float = 0.002):
-        self.interval = interval
+    def __init__(self):
         self.buffer: deque = deque()
         self.comm: Comm | None = None
         self.please_stop = False
@@ -53,12 +54,16 @@ class BatchedSend:
         self.waker.set()
 
     async def _background_send(self) -> None:
-        # idle streams block on the waker with NO timer: the previous
+        # idle streams block on the waker with NO timer (the pre-r4
         # wait_for(..., interval) tick created a Task + timeout context +
-        # heap timer per stream per 2 ms — with ~2 streams per worker
-        # that alone measurably loaded a single-core event loop.  A burst
-        # flushes immediately; the coalescing window applies between
-        # flushes, not in front of the first.
+        # heap timer per stream per 2 ms even with nothing to send), and
+        # a ready message flushes IMMEDIATELY — any sleep in this loop
+        # (before or after the flush) inserts its full length into every
+        # scheduler<->worker request-response round trip and stalls the
+        # whole pipeline (measured: a trailing interval-sleep cost
+        # +66 us/task; a leading one +400).  Coalescing still happens:
+        # messages arriving while comm.write awaits accumulate in the
+        # buffer and go out as one list on the next iteration.
         try:
             while not self.please_stop:
                 await self.waker.wait()
@@ -77,8 +82,6 @@ class BatchedSend:
                     payload.extend(self.buffer)
                     self.buffer = deque(payload)
                     break
-                if self.interval and not self.please_stop:
-                    await asyncio.sleep(self.interval)
         finally:
             self.stopped.set()
 

@@ -374,7 +374,7 @@ class Scheduler(Server):
         # concurrent send_all never drops messages for this worker, but only
         # start its flush loop AFTER the registration reply is on the wire —
         # otherwise a flushed batch could precede the handshake response
-        bs = BatchedSend(interval=0.002)
+        bs = BatchedSend()
         self.stream_comms[address] = bs
         await comm.write({"status": "OK", "time": time()})
         bs.start(comm)
@@ -502,7 +502,7 @@ class Scheduler(Server):
         # same ordering as add_worker: publish the buffering BatchedSend
         # before any await (no dropped reports), start it only after the
         # handshake reply (no batch ahead of the handshake)
-        bs = BatchedSend(interval=0.002)
+        bs = BatchedSend()
         self.client_comms[client] = bs
         await comm.write({"status": "OK", "time": time(),
                           "id": self.id, "type": type(self).__name__})

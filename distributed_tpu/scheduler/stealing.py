@@ -267,13 +267,24 @@ class WorkStealing:
         if self._kick_pending or not self.enabled or not self.state.idle:
             return
         self._kick_pending = True
-
-        async def _tick() -> None:
+        # plain TimerHandle, not a background Task: kicks fire on the
+        # per-task hot path, and a Task + sleep + done-callback per kick
+        # is measurable loop load at thousands of tasks/s
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
             self._kick_pending = False
-            if time() - self._last_balance >= 0.02:
-                self.balance()
+            return
+        loop.call_later(0.005, self._kick_tick)
 
-        self.scheduler._ongoing_background_tasks.call_later(0.005, _tick)
+    def _kick_tick(self) -> None:
+        self._kick_pending = False
+        if (
+            self.enabled
+            and not self.scheduler._ongoing_background_tasks.closed
+            and time() - self._last_balance >= 0.02
+        ):
+            self.balance()
 
     def balance(self) -> None:
         """One stealing cycle (reference stealing.py:402)."""

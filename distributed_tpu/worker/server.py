@@ -134,7 +134,7 @@ class Worker(Server):
         self.actor_executor = ThreadPoolExecutor(
             1, thread_name_prefix="dtpu-worker-actor"
         )
-        self.batched_stream = BatchedSend(interval=0.002)
+        self.batched_stream = BatchedSend()
         self.scheduler_comm: Comm | None = None
         self.heartbeat_interval = (
             heartbeat_interval if heartbeat_interval is not None else 1.0
