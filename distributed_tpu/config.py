@@ -29,6 +29,15 @@ defaults: dict[str, Any] = {
     "scheduler": {
         "allowed-failures": 3,          # reference distributed.yaml:12
         "bandwidth": 100_000_000,       # bytes/s cost-model constant (yaml:13)
+        # fixed cost charged per MISSING dependency on a candidate worker,
+        # on top of bytes/bandwidth: every fetch pays an RPC round trip
+        # (serialize, two loop handlings, deserialize) no matter how tiny
+        # the payload.  bytes/bandwidth alone makes transfers of small
+        # chunks look free, so the objective scatters reduction trees
+        # across workers and the cluster drowns in gather_dep chatter.
+        # The reference has no such term (its worker_objective is pure
+        # bytes/bandwidth, reference scheduler.py:3131).
+        "transfer-latency": "500us",
         "blocked-handlers": [],
         "default-task-durations": {"rechunk-split": "1us", "split-shuffle": "1us"},
         "events-cleanup-delay": "1h",
@@ -51,6 +60,11 @@ defaults: dict[str, Any] = {
                                         # oracle wins: whole-graph plans
                                         # diverge from stealing/queuing
                                         # dynamics faster than they pay off
+            # separate floor for the PERIODIC device kernels (stealing,
+            # AMM, rebalance): these dispatch on the event loop every
+            # cycle, so lowering min-workers to study placement hints
+            # must not drag a per-tick jax dispatch into small clusters
+            "periodic-min-workers": 48,
             "sync-plan": False,         # plan on-loop (deterministic tests)
             # skip graph planning when mean transfer cost is below this
             # fraction of mean task duration (locality can't pay there);

@@ -249,7 +249,8 @@ class ReduceReplicas(ActiveMemoryManagerPolicy):
         state = self.manager.state
         replicated = list(state.replicated_tasks)
         if device_dispatch_worthwhile(
-            len(state.workers), len(replicated), self.DEVICE_MIN_TASKS
+            len(state.workers), len(replicated), self.DEVICE_MIN_TASKS,
+            periodic=True,
         ):
             try:
                 yield from self._run_device(replicated)

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from concurrent.futures import ThreadPoolExecutor
+import threading
 from typing import TYPE_CHECKING, Any
 
 from distributed_tpu import config
@@ -34,18 +34,70 @@ logger = logging.getLogger("distributed_tpu.jax_placement")
 
 _DEFAULT_NBYTES = 10_000.0  # cost-model guess for unobserved outputs
 
+import os as _os
+_PARK_DEBUG: "list | None" = [] if _os.environ.get("DTPU_PARK_DEBUG") else None
+
+
+class _DaemonExecutor:
+    """Single daemon-thread executor with the tiny slice of the
+    concurrent.futures API the planner uses (submit/shutdown).
+
+    ThreadPoolExecutor threads are non-daemon and joined at interpreter
+    exit; a jax call blocked on a dead accelerator tunnel would pin the
+    process forever.  A daemon thread just dies with the process."""
+
+    def __init__(self, name: str):
+        import queue
+        from concurrent.futures import Future
+
+        self._Future = Future
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as exc:  # noqa: BLE001 - relayed to waiter
+                fut.set_exception(exc)
+
+    def submit(self, fn, *args):
+        fut = self._Future()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, wait: bool = False, cancel_futures: bool = False) -> None:
+        self._q.put(None)
+
 
 def device_dispatch_worthwhile(n_workers: int, n_items: int,
-                               min_items: int) -> bool:
+                               min_items: int,
+                               periodic: bool = False) -> bool:
     """Shared gate for every scheduler device-kernel path (placement,
     stealing, AMM): the co-processor pays off only with enough workers
     (below ``scheduler.jax.min-workers`` the O(deps) python oracles win)
-    and enough items to amortize a dispatch."""
-    return (
-        bool(config.get("scheduler.jax.enabled"))
-        and n_workers >= max(config.get("scheduler.jax.min-workers"), 2)
-        and n_items >= min_items
-    )
+    and enough items to amortize a dispatch.
+
+    ``periodic``: the caller dispatches on the event loop EVERY cycle
+    (stealing balance, AMM, rebalance) rather than once per graph, so it
+    keeps its own higher worker floor — forcing ``min-workers`` down to
+    study placement hints must not drag a per-tick jax dispatch into
+    small clusters (measured: 9x wall blowup at 16 workers)."""
+    if not config.get("scheduler.jax.enabled"):
+        return False
+    floor = max(config.get("scheduler.jax.min-workers"), 2)
+    if periodic:
+        floor = max(floor, config.get("scheduler.jax.periodic-min-workers"))
+    return n_workers >= floor and n_items >= min_items
 
 
 class JaxPlacement:
@@ -87,12 +139,13 @@ class JaxPlacement:
         self.plans_computed = 0
         self.plan_hits = 0
         self.plan_misses = 0
+        self.plan_parks = 0
         self.plans_inflight = 0
         # miss breakdown (diagnostics): why CONSULTED hints were refused
         # (these partition plan_misses exactly)
         self.miss_reasons: dict[str, int] = {
             "worker-gone": 0, "restricted": 0, "dep-moved": 0,
-            "idle-yield": 0,
+            "idle-yield": 0, "park-declined": 0,
         }
         # hints discarded WITHOUT being consulted (not misses): pruned
         # as stale, or landed after the oracle had already placed them
@@ -100,7 +153,7 @@ class JaxPlacement:
             "stale-dropped": 0, "landed-late": 0,
         }
         self.enabled = True
-        self._executor: ThreadPoolExecutor | None = None
+        self._executor: _DaemonExecutor | None = None
 
     # ------------------------------------------------------------- hooks
 
@@ -116,19 +169,42 @@ class JaxPlacement:
             k: a for k, a in self.plan.items()
             if a[0] is not None or a[1] != addr
         }
+        # parked-task splicing on worker death lives in
+        # SchedulerState.remove_worker (the state owns queue structures)
 
     def wants(self, ts: "TaskState") -> bool:
         return self.enabled and ts.key in self.plan
 
-    def decide_worker(
+    # -------------------------------------------------------- consumption
+    #
+    # A plan's value is PROSPECTIVE locality: it co-assigns whole
+    # subtrees so that once the first task of a tile runs home, every
+    # later one finds its inputs local.  Consume-time objective
+    # comparisons (occupancy + bytes already in place) cannot see that —
+    # at decide time of the EARLY tasks nothing is local anywhere, so
+    # "yield to any idle worker" systematically shreds the plan
+    # (measured: even a hand-computed comm-optimal tiling lost to the
+    # oracle when consumed through idle-yield).  The rules here:
+    #
+    #   open slot on the home worker  -> place there (hit)
+    #   home busy, short backlog      -> PARK: the task queues scheduler-
+    #                                    side and the home worker pulls it
+    #                                    at its next slot-open
+    #   home backlog beyond slack     -> the plan has drifted from live
+    #                                    load: yield to the idle worker
+    #                                    (objective with transfer latency)
+
+    def resolve(
         self,
         state: "SchedulerState",
         ts: "TaskState",
         valid_workers: "set[WorkerState] | None",
-    ) -> "WorkerState | None":
-        entry = self.plan.pop(ts.key, None)
+    ) -> "tuple[str, WorkerState | None]":
+        """(verdict, ws): ("hit", ws) place now; ("park", ws) defer to
+        ws's queue-pull; ("miss", None) hint unusable, use the oracle."""
+        entry = self.plan.get(ts.key)
         if entry is None:
-            return None
+            return "miss", None
         follow_key, addr = entry
         if follow_key is not None:
             # locality hint: follow the chosen dependency to its LIVE
@@ -145,51 +221,111 @@ class JaxPlacement:
                         ws = cand
                         break
             if ws is None:
-                self.plan_misses += 1
-                reason = (
+                return self._miss(
+                    ts,
                     "restricted"
                     if dts is not None
                     and any(c in state.running for c in dts.who_has)
-                    else "dep-moved"
+                    else "dep-moved",
                 )
-                self.miss_reasons[reason] += 1
-                return None
         else:
             ws = state.workers.get(addr)
             if ws is None or ws not in state.running:
-                self.plan_misses += 1
-                self.miss_reasons["worker-gone"] += 1
-                return None
+                return self._miss(ts, "worker-gone")
             if valid_workers is not None and ws not in valid_workers:
-                self.plan_misses += 1
-                self.miss_reasons["restricted"] += 1
-                return None
-        if state.idle and ws.address not in state.idle:
-            # The plan's wave model has drifted from live execution:
-            # capacity sits idle while the hint targets a busy worker.
-            # Blindly following it stacks queues that WorkStealing then
-            # drains AWAY from the data — plan and stealer fighting each
-            # other (measured: hints+stealing slower than either alone).
-            # Compare the oracle's objective (occupancy + transfer cost,
-            # reference scheduler.py:3131 worker_objective) for the hint
-            # vs an idle worker and yield when the hint is worse.
+                return self._miss(ts, "restricted")
+
+        # home accepts up to a small stack beyond the open-slot line:
+        # a worker fed exactly one task per slot-open goes dry for a
+        # scheduler round trip between tasks (completion -> stimulus ->
+        # pull -> compute-task message); a couple of queued-ahead tasks
+        # keep its pipeline full while still bounding the pile-up that
+        # stealing would otherwise drain away
+        import math as _math
+
+        sat = state.WORKER_SATURATION
+        depth = (
+            _math.ceil(ws.nthreads * sat) if _math.isfinite(sat)
+            else 2 * ws.nthreads
+        ) + ws.nthreads
+        if len(ws.processing) < depth:
+            del self.plan[ts.key]
+            self.plan_hits += 1
+            return "hit", ws
+
+        # home is busy: park while its backlog is in line with the rest
+        # of the cluster.  The plan balanced load GLOBALLY, so during a
+        # ready-burst every worker's queue deepens together — comparing
+        # the home against zero would shred the plan exactly when it is
+        # working.  Yield only when the home is an OUTLIER vs the
+        # cluster-average backlog (the plan drifted from live load).
+        backlog = ws.occupancy / max(ws.nthreads, 1)
+        avg = (
+            state.total_occupancy / state.total_nthreads
+            if state.total_nthreads
+            else 0.0
+        )
+        slack = avg + max(
+            8 * state.transfer_latency, 2 * state.get_task_duration(ts)
+        )
+        if _PARK_DEBUG is not None:
+            _PARK_DEBUG.append((backlog, slack))
+        if backlog <= slack:
+            self.plan_parks += 1
+            return "park", ws
+
+        if state.idle:
             idle_ws = next(iter(state.idle.values()))
             bw = state.bandwidth
+            lat = state.transfer_latency
 
             def objective(w: "WorkerState") -> float:
-                missing = sum(
-                    dts.nbytes
-                    for dts in ts.dependencies
-                    if w not in dts.who_has and dts.nbytes > 0
+                missing = 0.0
+                n_missing = 0
+                for dts in ts.dependencies:
+                    if w not in dts.who_has:
+                        n_missing += 1
+                        if dts.nbytes > 0:
+                            missing += dts.nbytes
+                # same cost model as worker_objective: a fetch pays a
+                # fixed RPC latency regardless of payload size, so the
+                # hint (zero missing deps) wins ties against "any idle
+                # worker" whenever following it avoids real transfers
+                return (
+                    w.occupancy / max(w.nthreads, 1)
+                    + missing / bw
+                    + n_missing * lat
                 )
-                return w.occupancy / max(w.nthreads, 1) + missing / bw
 
             if objective(idle_ws) < objective(ws):
-                self.plan_misses += 1
-                self.miss_reasons["idle-yield"] += 1
-                return None
+                return self._miss(ts, "idle-yield")
+        del self.plan[ts.key]
         self.plan_hits += 1
-        return ws
+        return "hit", ws
+
+    def _miss(self, ts: "TaskState", reason: str):
+        self.plan.pop(ts.key, None)
+        self.plan_misses += 1
+        self.miss_reasons[reason] += 1
+        return "miss", None
+
+    def decide_worker(
+        self,
+        state: "SchedulerState",
+        ts: "TaskState",
+        valid_workers: "set[WorkerState] | None",
+    ) -> "WorkerState | None":
+        """Legacy entry (no-worker recovery, opaque control planes):
+        hit-or-miss only.  A would-be park is consumed as a miss — the
+        caller is about to place the task elsewhere, so keeping the hint
+        (and the park tally) would leak plan entries forever."""
+        verdict, ws = self.resolve(state, ts, valid_workers)
+        if verdict == "park":
+            self.plan_parks -= 1
+            self._miss(ts, "park-declined")
+            return None
+        return ws if verdict == "hit" else None
+
 
     # ---------------------------------------------------------- planning
 
@@ -260,9 +396,13 @@ class JaxPlacement:
             return len(plan)
 
         if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                1, thread_name_prefix="jax-placement"
-            )
+            # daemon planning thread: jax backend init can block
+            # INDEFINITELY when the accelerator tunnel is wedged, and a
+            # non-daemon executor thread stuck in make_c_api_client
+            # keeps the whole process from exiting (concurrent.futures
+            # joins its threads atexit).  The plan simply never lands;
+            # the python oracle carries the graph.
+            self._executor = _DaemonExecutor("jax-placement")
         self.plans_inflight += 1
         fut = self._executor.submit(self._plan_from_arrays, *snapshot)
 

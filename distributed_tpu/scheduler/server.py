@@ -743,6 +743,14 @@ class Scheduler(Server):
             self.state.running.discard(ws)
             self.state.idle.pop(ws.address, None)
             self.state.idle_task_count.discard(ws)
+            # a paused home can't pull: return its parked tasks to the
+            # global pop heap and let open slots elsewhere take them
+            if ws.address in self.state.parked:
+                self.state.splice_parked(ws.address)
+                stimulus_id = stimulus_id or seq_name("worker-paused")
+                recs = self.state.stimulus_queue_slots_maybe_opened(stimulus_id)
+                cm, wm = self.state.transitions(recs, stimulus_id)
+                self.send_all(cm, wm)
         elif status == "running":
             self.state.running.add(ws)
             self.state.check_idle_saturated(ws)
@@ -1222,7 +1230,8 @@ class Scheduler(Server):
                     continue
                 cand.append(ts)
                 owner.append(wi)
-        if device_dispatch_worthwhile(len(wss), len(cand), min_items=512):
+        if device_dispatch_worthwhile(len(wss), len(cand), min_items=512,
+                                      periodic=True):
             moves = self._rebalance_plan_device(wss, cand, owner)
         else:
             moves = self._rebalance_plan_python(wss, keyset)

@@ -389,6 +389,17 @@ class SchedulerState:
         self.running: set[WorkerState] = set()
 
         self.queued: HeapSet[TaskState] = HeapSet(key=lambda ts: ts.priority)
+        # placement-parked subset of ``queued``: tasks deferred for ONE
+        # worker's next slot-open (plan co-assignment), indexed by home
+        # address.  They are deliberately kept OUT of the globally
+        # poppable heap — a queue head wall-to-wall with parked tasks
+        # would otherwise be re-scanned on every completion.
+        # queued == queued_unparked  ∪  {tasks in parked heaps}
+        self.queued_unparked: HeapSet[TaskState] = HeapSet(
+            key=lambda ts: ts.priority
+        )
+        self.parked: dict[str, HeapSet[TaskState]] = {}
+        self._parked_keys: dict[Key, str] = {}
         self.unrunnable: dict[TaskState, float] = {}
         self.replicated_tasks: set[TaskState] = set()
 
@@ -429,6 +440,9 @@ class SchedulerState:
         ws_cfg = config.get("scheduler.worker-saturation")
         self.WORKER_SATURATION: float = float("inf") if ws_cfg in ("inf", None) else float(ws_cfg)
         self.bandwidth: float = float(config.get("scheduler.bandwidth"))
+        self.transfer_latency: float = config.parse_timedelta(
+            config.get("scheduler.transfer-latency")
+        )
         self.ALLOWED_FAILURES: int = config.get("scheduler.allowed-failures")
         self.DEFAULT_TASK_DURATIONS: dict[str, float] = {
             k: config.parse_timedelta(v)
@@ -498,6 +512,9 @@ class SchedulerState:
         ):
             coll.clear()
         self.queued.clear()
+        self.queued_unparked.clear()
+        self.parked.clear()
+        self._parked_keys.clear()
         # per-worker mirrors reference the cleared TaskStates: reset them
         # too or memory/occupancy accounting is permanently wrong
         for ws in self.workers.values():
@@ -675,6 +692,24 @@ class SchedulerState:
                 if not (ws := self.decide_worker_rootish_queuing_disabled(ts)):
                     return {ts.key: "no-worker"}, {}, {}
         else:
+            if (
+                self.placement is not None
+                and not ts.actor
+                and self.placement.wants(ts)
+            ):
+                verdict, pws = self.placement.resolve(
+                    self, ts, self._valid_or_running(ts)
+                )
+                if verdict == "park":
+                    # defer for the home worker's next slot-open: the
+                    # task queues scheduler-side and the home worker
+                    # pulls it via stimulus_queue_slots_maybe_opened
+                    self.park_task(ts, pws)
+                    return {ts.key: "queued"}, {}, {}
+                if verdict == "hit":
+                    worker_msgs = self._add_to_processing(ts, pws, stimulus_id)
+                    self._count_transition(ts, "waiting", "processing")
+                    return {}, {}, worker_msgs
             if not (ws := self.decide_worker_non_rootish(ts)):
                 if ts.waiting_on:
                     # A dependency's last replica vanished between the
@@ -730,10 +765,17 @@ class SchedulerState:
         ts = self.tasks[key]
         if self.validate:
             assert ts not in self.queued
-            assert not self.idle_task_count, (ts, self.idle_task_count)
+            # rootish tasks queue only when no slot is open anywhere; a
+            # PARKED task queues deliberately while other workers have
+            # slots — it is waiting for its home worker specifically
+            assert not self.idle_task_count or self.is_parked(key), (
+                ts, self.idle_task_count,
+            )
         ts.state = "queued"
         self._count_transition(ts, "waiting", "queued")
         self.queued.add(ts)
+        if key not in self._parked_keys:
+            self.queued_unparked.add(ts)
         return {}, {}, {}
 
     def _transition_waiting_no_worker(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
@@ -791,6 +833,8 @@ class SchedulerState:
     def _transition_queued_released(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
         ts = self.tasks[key]
         self.queued.discard(ts)
+        self.queued_unparked.discard(ts)
+        self.unpark_task(ts, requeue=False)
         ts.state = "released"
         self._count_transition(ts, "queued", "released")
         recommendations: dict[Key, str] = {}
@@ -801,10 +845,47 @@ class SchedulerState:
         ts = self.tasks[key]
         if self.validate:
             assert not ts.actor, "queued actors not supported"
-        ws = self.decide_worker_rootish_queuing_enabled()
+        pl = self.placement
+        if pl is not None and (self.is_parked(key) or pl.wants(ts)):
+            # parked/hinted task: re-resolve against live state.  Home
+            # slot open -> go home (this stimulus usually IS the home
+            # worker freeing a slot).  Still busy within slack -> keep
+            # waiting (re-registering in the index: _parked_pop_for pops
+            # destructively).  Home gone/overloaded -> resolve falls to
+            # hit-elsewhere or miss; on miss take the least busy
+            # open-slot worker (queued semantics require an open slot).
+            valid = self._valid_or_running(ts)
+            verdict, ws = pl.resolve(self, ts, valid)
+            if verdict == "park":
+                self.park_task(ts, ws)
+                return {}, {}, {}
+            if verdict != "hit":
+                # restriction-aware fallback: the rootish pick ignores
+                # valid_workers (safe there — rootish tasks are never
+                # restricted), but parked tasks are non-rootish and may
+                # carry worker/host/resource restrictions
+                cands = [
+                    w for w in self.idle_task_count
+                    if valid is None or w in valid
+                ]
+                ws = min(
+                    cands,
+                    key=lambda w: (len(w.processing) / max(w.nthreads, 1),
+                                   w.address),
+                    default=None,
+                )
+        else:
+            ws = self.decide_worker_rootish_queuing_enabled()
         if ws is None:
+            # nothing can run it right now; it must stay POPPABLE — a
+            # destructively-popped parked task left in neither heap would
+            # strand forever (no stimulus ever revisits it)
+            if not self.is_parked(key) and ts not in self.queued_unparked:
+                self.queued_unparked.add(ts)
             return {}, {}, {}  # remain queued
         self.queued.discard(ts)
+        self.queued_unparked.discard(ts)
+        self.unpark_task(ts, requeue=False)
         worker_msgs = self._add_to_processing(ts, ws, stimulus_id)
         self._count_transition(ts, "queued", "processing")
         return {}, {}, worker_msgs
@@ -1325,14 +1406,25 @@ class SchedulerState:
                 dts for dts in ts.dependencies.difference(ws.has_what)
             ]
         nbytes = sum(dts.get_nbytes() for dts in deps)
-        return nbytes / self.bandwidth
+        return nbytes / self.bandwidth + len(deps) * self.transfer_latency
 
     def worker_objective(self, ts: TaskState, ws: WorkerState) -> tuple:
-        """Lower is better (reference scheduler.py:3131)."""
-        dep_bytes = sum(
-            dts.get_nbytes() for dts in ts.dependencies if ws not in dts.who_has
+        """Lower is better (reference scheduler.py:3131 — plus a fixed
+        per-missing-dep latency term the reference lacks: with tiny
+        chunks, bytes/bandwidth alone calls transfers free and the
+        objective degenerates to load-balancing, scattering reduction
+        trees and drowning the loop in gather_dep RPCs)."""
+        n_missing = 0
+        dep_bytes = 0
+        for dts in ts.dependencies:
+            if ws not in dts.who_has:
+                n_missing += 1
+                dep_bytes += dts.get_nbytes()
+        stack_time = (
+            ws.occupancy / max(ws.nthreads, 1)
+            + dep_bytes / self.bandwidth
+            + n_missing * self.transfer_latency
         )
-        stack_time = ws.occupancy / max(ws.nthreads, 1) + dep_bytes / self.bandwidth
         start_time = stack_time + self.get_task_duration(ts)
         if ts.actor:
             return (len(ws.actors), start_time, ws.nbytes)
@@ -1392,13 +1484,19 @@ class SchedulerState:
             assert not _worker_full(ws, self.WORKER_SATURATION), (ws, self.WORKER_SATURATION)
         return ws
 
+    def _valid_or_running(self, ts: TaskState) -> set[WorkerState] | None:
+        """Restriction set for placement decisions; running-only when
+        some workers are paused (same narrowing as decide_worker_non_rootish)."""
+        valid_workers = self.valid_workers(ts)
+        if valid_workers is None and len(self.running) < len(self.workers):
+            valid_workers = self.running
+        return valid_workers
+
     def decide_worker_non_rootish(self, ts: TaskState) -> WorkerState | None:
         """Place by data locality + occupancy (reference scheduler.py:2247, 8550)."""
         if not self.running:
             return None
-        valid_workers = self.valid_workers(ts)
-        if valid_workers is None and len(self.running) < len(self.workers):
-            valid_workers = self.running
+        valid_workers = self._valid_or_running(ts)
         if self.placement is not None and self.placement.wants(ts):
             ws = self.placement.decide_worker(self, ts, valid_workers)
             if ws is not None:
@@ -1536,15 +1634,92 @@ class SchedulerState:
             math_ceil(ws.nthreads * self.WORKER_SATURATION) - len(ws.processing), 0
         )
 
+    # ------------------------------------------------------- parked tasks
+
+    def park_task(self, ts: TaskState, ws: WorkerState) -> None:
+        """Register a queued task as waiting for ws's next slot-open.
+        Parked tasks live in ``queued`` (state invariants) but NOT in
+        ``queued_unparked`` (global pops)."""
+        heap = self.parked.get(ws.address)
+        if heap is None:
+            heap = self.parked[ws.address] = HeapSet(
+                key=lambda t: t.priority
+            )
+        heap.add(ts)
+        self._parked_keys[ts.key] = ws.address
+        self.queued_unparked.discard(ts)
+
+    def unpark_task(self, ts: TaskState, requeue: bool = True) -> None:
+        """Drop park bookkeeping; re-enter global pops when ``requeue``
+        (leaving-queued callers pass False)."""
+        addr = self._parked_keys.pop(ts.key, None)
+        if addr is not None:
+            heap = self.parked.get(addr)
+            if heap is not None:
+                heap.discard(ts)
+                if not heap:
+                    del self.parked[addr]
+            if requeue and ts.state == "queued":
+                self.queued_unparked.add(ts)
+
+    def is_parked(self, key: Key) -> bool:
+        return key in self._parked_keys
+
+    def splice_parked(self, address: str) -> None:
+        """Return every task parked for ``address`` to the global pop
+        heap — the home can no longer pull (paused / removed / dead)."""
+        heap = self.parked.pop(address, None)
+        if heap is not None:
+            for ts in list(heap):
+                self._parked_keys.pop(ts.key, None)
+                if ts.state == "queued":
+                    self.queued_unparked.add(ts)
+
+    def _parked_pop_for(self, ws: WorkerState, n: int) -> list[TaskState]:
+        """Up to n parked tasks for ws, best priority first — DESTRUCTIVE
+        (the queued->processing transition re-parks any that must keep
+        waiting), so repeatedly-scanned stale entries never build up."""
+        heap = self.parked.get(ws.address)
+        if heap is None:
+            return []
+        out: list[TaskState] = []
+        while heap and len(out) < n:
+            ts = heap.pop()
+            self._parked_keys.pop(ts.key, None)
+            if ts.state == "queued":
+                out.append(ts)
+        if not heap:
+            self.parked.pop(ws.address, None)
+        return out
+
     def stimulus_queue_slots_maybe_opened(self, stimulus_id: str) -> dict[Key, str]:
         """Pop exactly as many queued tasks as there are open slots
-        (reference scheduler.py:4983)."""
+        (reference scheduler.py:4983).
+
+        Each open-slot worker first pulls tasks PARKED for it (the
+        placement plan's co-assignment, pulled past the slot line so the
+        worker pipeline never drains between stimuli); the global
+        priority order over non-parked tasks fills what remains."""
         if not self.queued:
             return {}
-        slots = sum(self._task_slots_available(ws) for ws in self.idle_task_count)
-        if slots <= 0:
-            return {}
-        return {ts.key: "processing" for ts in list(self.queued.peekn(slots))}
+        recs: dict[Key, str] = {}
+        slots = 0
+        if self._parked_keys:
+            for ws in self.idle_task_count:
+                s = self._task_slots_available(ws)
+                slots += s
+                if ws.address in self.parked:
+                    for ts in self._parked_pop_for(ws, s + ws.nthreads):
+                        recs[ts.key] = "processing"
+        else:
+            slots = sum(
+                self._task_slots_available(ws) for ws in self.idle_task_count
+            )
+        remaining = slots - len(recs)
+        if remaining > 0 and self.queued_unparked:
+            for ts in self.queued_unparked.peekn(remaining):
+                recs[ts.key] = "processing"
+        return recs
 
     # ------------------------------------------------------ replica model
 
@@ -1776,6 +1951,8 @@ class SchedulerState:
             self.resources[r].pop(address, None)
         if self.placement is not None:
             self.placement.on_remove_worker(self, ws)
+        # tasks parked for the dead worker become globally poppable again
+        self.splice_parked(address)
 
         recommendations: dict[Key, str] = {}
         client_msgs: dict = {}
@@ -1958,8 +2135,22 @@ class SchedulerState:
                         # HeapSet orders by add-time priority: re-add so
                         # the bump is visible to peekn/pop, not stale
                         self.queued.remove(ts)
+                        in_global = ts in self.queued_unparked
+                        if in_global:
+                            self.queued_unparked.remove(ts)
+                        pheap = self.parked.get(
+                            self._parked_keys.get(ts.key, "")
+                        )
+                        if pheap is not None and ts in pheap:
+                            pheap.remove(ts)
+                        else:
+                            pheap = None
                         ts.priority = new_pri
                         self.queued.add(ts)
+                        if in_global:
+                            self.queued_unparked.add(ts)
+                        if pheap is not None:
+                            pheap.add(ts)
                     else:
                         ts.priority = new_pri
             if (actors is True) or (isinstance(actors, list) and key in actors):
@@ -2109,6 +2300,21 @@ class SchedulerState:
             self.validate_worker_state(ws)
         for ts in self.queued:
             assert ts.state == "queued", ts
+        # parked bookkeeping: queued is the disjoint union of the global
+        # pop heap and the per-worker parked heaps
+        n_parked = 0
+        for addr, heap in self.parked.items():
+            for ts in heap:
+                if ts.state == "queued":
+                    n_parked += 1
+                    assert ts not in self.queued_unparked, ts
+                    assert self._parked_keys.get(ts.key) == addr, ts
+        for ts in self.queued_unparked:
+            assert ts in self.queued, ts
+        for ts in self.queued:
+            assert ts in self.queued_unparked or ts.key in self._parked_keys, (
+                "queued task reachable by no pop path", ts,
+            )
         for ts in self.unrunnable:
             assert ts.state == "no-worker", ts
 

@@ -303,6 +303,7 @@ class WorkStealing:
             len(s.workers),
             sum(len(t) for levels in self.stealable.values() for t in levels),
             self.DEVICE_MIN_TASKS,
+            periodic=True,
         ):
             try:
                 self._balance_device(idle_workers)

@@ -134,13 +134,25 @@ class HeapSet(Generic[T]):
             return iter(())
         if n == 1:
             return iter((self.peek(),))
-        heap = self._heap.copy()  # O(Q), zero key() calls
+        # lazy frontier walk over the heap ARRAY (children of index i are
+        # at 2i+1 / 2i+2): visits O(n + stale) entries with a tiny aux
+        # heap instead of copying the whole O(Q) heap per call — this
+        # runs on EVERY task completion while the queue is long
+        h = self._heap
         out: list[T] = []
-        while heap and len(out) < n:
-            _, inc, ref = heapq.heappop(heap)
+        frontier: list[tuple[Any, int, int, Any]] = []  # (prio, inc, idx, ref)
+        if h:
+            prio, inc, ref = h[0]
+            frontier.append((prio, inc, 0, ref))
+        while frontier and len(out) < n:
+            _, inc, i, ref = heapq.heappop(frontier)
             el = self._live(inc, ref)
             if el is not None:
                 out.append(el)
+            for c in (2 * i + 1, 2 * i + 2):
+                if c < len(h):
+                    prio, cinc, cref = h[c]
+                    heapq.heappush(frontier, (prio, cinc, c, cref))
         return iter(out)
 
     def sorted(self) -> list[T]:

@@ -135,6 +135,10 @@ class Worker(Server):
             1, thread_name_prefix="dtpu-worker-actor"
         )
         self.batched_stream = BatchedSend()
+        # cumulative peer-serve counters (observability + benchmarks:
+        # placement quality shows up directly as fewer get_data serves)
+        self.get_data_requests = 0
+        self.get_data_keys_served = 0
         self.scheduler_comm: Comm | None = None
         self.heartbeat_interval = (
             heartbeat_interval if heartbeat_interval is not None else 1.0
@@ -413,6 +417,8 @@ class Worker(Server):
         for k in keys:
             if k in self.data:
                 data[k] = Serialize(self.data[k])
+        self.get_data_requests += 1
+        self.get_data_keys_served += len(data)
         nbytes = {k: self.state.tasks[k].nbytes if k in self.state.tasks
                   else sizeof(self.data[k]) for k in data}
         self._fine_metric("get-data", None, "", "serve", "seconds", time() - t0)

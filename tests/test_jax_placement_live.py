@@ -132,12 +132,12 @@ async def test_placement_disabled_by_flag():
             assert await c.submit(inc, 1).result() == 2
 
 
-def test_hint_yields_to_idle_worker_unless_locality_pays():
-    """Occupancy-aware hint consumption: when capacity sits idle and the
-    planned worker is busy, the hint holds only if the transfer cost it
-    avoids outweighs the wait (reference scheduler.py:3131
-    worker_objective semantics); otherwise it defers to the oracle so
-    the plan and WorkStealing never fight over the same queue."""
+def test_hint_resolution_hit_park_yield():
+    """Three-verdict hint consumption: open slot -> hit; home stacked but
+    in line with the cluster-average backlog -> park (the home pulls it
+    at its next slot-open); home an outlier vs the average -> yield to
+    an idle worker unless the transfer cost it avoids outweighs the wait
+    (worker_objective semantics with the fixed per-fetch latency)."""
     from distributed_tpu.scheduler.state import SchedulerState
 
     state = SchedulerState(validate=True)
@@ -155,21 +155,45 @@ def test_hint_yields_to_idle_worker_unless_locality_pays():
 
     placement = JaxPlacement(min_batch=1, min_workers=0, sync=True)
 
-    # busy worker has queued work; the other is idle
-    busy.occupancy = 10.0
+    # open slot on the home -> immediate hit, no second-guessing
+    placement.plan = {ts.key: (dep.key, busy.address)}
+    verdict, ws = placement.resolve(state, ts, None)
+    assert (verdict, ws) == ("hit", busy)
+    assert placement.plan_hits == 1
+
+    # fill the home's stack beyond the accepted depth
+    import math
+
+    depth = math.ceil(busy.nthreads * state.WORKER_SATURATION) + busy.nthreads
+    for i in range(depth):
+        filler = state.new_task(f"filler-{i}", None, "released")
+        busy.processing[filler] = 0.001
     state.idle.pop(busy.address, None)
+    state.idle_task_count.discard(busy)
     assert idle.address in state.idle
 
-    # tiny dep: waiting behind 10s of queue to save a 1-byte transfer is
-    # absurd -> hint yields (miss), oracle will use the idle worker
+    # home backlog in line with the cluster average -> park for the home
+    busy.occupancy = 0.002
+    state._total_occupancy = 0.002
     dep.nbytes = 1
     placement.plan = {ts.key: (dep.key, busy.address)}
-    assert placement.decide_worker(state, ts, None) is None
+    verdict, ws = placement.resolve(state, ts, None)
+    assert (verdict, ws) == ("park", busy)
+    assert placement.plan_parks == 1
+    assert ts.key in placement.plan  # hint kept for the later pull
+
+    # home an OUTLIER vs the average + tiny dep: waiting behind 10s of
+    # queue to save a 1-byte transfer is absurd -> yield (miss)
+    busy.occupancy = 10.0
+    state._total_occupancy = 10.0
+    placement.plan = {ts.key: (dep.key, busy.address)}
+    verdict, ws = placement.resolve(state, ts, None)
+    assert (verdict, ws) == ("miss", None)
     assert placement.plan_misses == 1
 
     # huge dep (100s at the configured bandwidth): locality beats the
-    # 10s queue -> hint holds
+    # 10s queue -> hint holds even on an outlier home
     dep.nbytes = int(state.bandwidth * 100)
     placement.plan = {ts.key: (dep.key, busy.address)}
-    assert placement.decide_worker(state, ts, None) is busy
-    assert placement.plan_hits == 1
+    verdict, ws = placement.resolve(state, ts, None)
+    assert (verdict, ws) == ("hit", busy)
