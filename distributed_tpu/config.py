@@ -56,16 +56,35 @@ defaults: dict[str, Any] = {
             "platform": "auto",         # auto | tpu | cpu
             "batch-size": 2048,         # stimulus batch per device step
             "min-batch": 512,           # below this, pure-python path is faster
-            "min-workers": 32,          # below this, the O(deps) python
-                                        # oracle wins: whole-graph plans
-                                        # diverge from stealing/queuing
-                                        # dynamics faster than they pay off
+            "min-workers": 8,           # below this the O(deps) python
+                                        # oracle wins; the partitioner
+                                        # planner pays from ~8 workers on
+                                        # transfer-heavy graphs (measured
+                                        # 17-30% wall at 16 workers)
             # separate floor for the PERIODIC device kernels (stealing,
             # AMM, rebalance): these dispatch on the event loop every
             # cycle, so lowering min-workers to study placement hints
             # must not drag a per-tick jax dispatch into small clusters
             "periodic-min-workers": 48,
             "sync-plan": False,         # plan on-loop (deterministic tests)
+            # graph-partitioner engine for the placement plan:
+            # auto  = jitted kernel, numpy fallback on failure
+            # numpy = skip jax entirely (no-device hosts, tests)
+            # off   = always use the leveled wave placer
+            "partitioner": "auto",
+            # home-stack depth for plan hints, in worker-thread units
+            # beyond the open-slot line: a hinted task lands directly on
+            # its busy home while fewer than
+            #   ceil(nthreads*saturation) + home-depth*nthreads
+            # tasks are processing there (worker-side queue, no extra
+            # scheduler transitions); beyond that it parks scheduler-side
+            # for the home's next slot-open. "inf" = never park.
+            "home-depth": "inf",
+            # allow the backlog-outlier check to yield a hinted task to
+            # an idle worker when its home has fallen far behind.  Off =
+            # trust the plan absolutely (uniform fleets; drift is then
+            # handled only by pause/death splicing)
+            "drift-yield": True,
             # skip graph planning when mean transfer cost is below this
             # fraction of mean task duration (locality can't pay there);
             # 0 disables the gate
@@ -87,6 +106,16 @@ defaults: dict[str, Any] = {
         "transfer": {
             "message-bytes-limit": "50MB",   # yaml:89
         },
+        # run a task INLINE on the event loop (no executor round trip)
+        # when its prefix's measured in-thread duration EMA is below
+        # this; at most ~5ms of inline work per 20ms window so the loop
+        # never starves.  "0" disables — the default: on a single-core
+        # host the executor handoff is nearly free (GIL interleaving)
+        # while inlining blocks the loop's comm multiplexing (measured
+        # +9% wall on the tensordot bench).  Worth enabling on real
+        # multi-core workers with sub-100us task storms.
+        # (No reference equivalent: dask always offloads, worker.py:2210.)
+        "inline-threshold": "0",
         "connections": {"outgoing": 50, "incoming": 10},
         "preload": [],
         "preload-argv": [],

@@ -396,6 +396,16 @@ class Server:
                             await result
                     except Exception:
                         logger.exception("stream handler %r failed", op)
+                # payload boundary: servers that coalesce stream stimuli
+                # (the worker's event buffer) flush here, SYNCHRONOUSLY,
+                # so a whole batched payload becomes one state-machine
+                # batch and no locally-generated event can interleave
+                flush = getattr(self, "stream_payload_flush", None)
+                if flush is not None:
+                    try:
+                        flush()
+                    except Exception:
+                        logger.exception("stream payload flush failed")
         except CommClosedError:
             pass
         finally:

@@ -131,6 +131,9 @@ class JaxPlacement:
             else config.get("scheduler.jax.min-workers")
         )
         self.max_batch = max_batch or 1_000_000
+        hd = config.get("scheduler.jax.home-depth")
+        self.home_depth: int | None = None if hd in ("inf", None) else int(hd)
+        self.drift_yield = bool(config.get("scheduler.jax.drift-yield"))
         self.sync = (
             sync if sync is not None
             else bool(config.get("scheduler.jax.sync-plan"))
@@ -235,46 +238,35 @@ class JaxPlacement:
             if valid_workers is not None and ws not in valid_workers:
                 return self._miss(ts, "restricted")
 
-        # home accepts up to a small stack beyond the open-slot line:
-        # a worker fed exactly one task per slot-open goes dry for a
-        # scheduler round trip between tasks (completion -> stimulus ->
-        # pull -> compute-task message); a couple of queued-ahead tasks
-        # keep its pipeline full while still bounding the pile-up that
-        # stealing would otherwise drain away
-        import math as _math
-
-        sat = state.WORKER_SATURATION
-        depth = (
-            _math.ceil(ws.nthreads * sat) if _math.isfinite(sat)
-            else 2 * ws.nthreads
-        ) + ws.nthreads
-        if len(ws.processing) < depth:
-            del self.plan[ts.key]
-            self.plan_hits += 1
-            return "hit", ws
-
-        # home is busy: park while its backlog is in line with the rest
-        # of the cluster.  The plan balanced load GLOBALLY, so during a
-        # ready-burst every worker's queue deepens together — comparing
-        # the home against zero would shred the plan exactly when it is
-        # working.  Yield only when the home is an OUTLIER vs the
-        # cluster-average backlog (the plan drifted from live load).
+        # drift check FIRST (even before the open-slot test: a home with
+        # a free slot but an hour of occupancy must not absorb more):
+        # the plan balanced load GLOBALLY, so during a ready-burst every
+        # worker's queue deepens together — the home only loses its
+        # claim when it is an OUTLIER vs the cluster-average backlog.
         backlog = ws.occupancy / max(ws.nthreads, 1)
         avg = (
             state.total_occupancy / state.total_nthreads
             if state.total_nthreads
             else 0.0
         )
-        slack = avg + max(
-            8 * state.transfer_latency, 2 * state.get_task_duration(ts)
-        )
+        if self.home_depth is None:
+            # deep-stack mode: tiles become READY at different times, so
+            # mid-graph the dispatched load is always concentrated on
+            # whichever tiles unblocked first — that is the pipeline
+            # working, not drift.  Only an extreme, persistent outlier
+            # (a genuinely slow/overloaded home) sheds load.
+            slack = 4.0 * avg + max(
+                8 * state.transfer_latency,
+                2 * state.get_task_duration(ts),
+                2.0,
+            )
+        else:
+            slack = avg + max(
+                8 * state.transfer_latency, 2 * state.get_task_duration(ts)
+            )
         if _PARK_DEBUG is not None:
             _PARK_DEBUG.append((backlog, slack))
-        if backlog <= slack:
-            self.plan_parks += 1
-            return "park", ws
-
-        if state.idle:
+        if backlog > slack and state.idle and self.drift_yield:
             idle_ws = next(iter(state.idle.values()))
             bw = state.bandwidth
             lat = state.transfer_latency
@@ -299,9 +291,31 @@ class JaxPlacement:
 
             if objective(idle_ws) < objective(ws):
                 return self._miss(ts, "idle-yield")
-        del self.plan[ts.key]
-        self.plan_hits += 1
-        return "hit", ws
+
+        # home accepts up to a stack beyond the open-slot line: a worker
+        # fed exactly one task per slot-open goes dry for a scheduler
+        # round trip between tasks (completion -> stimulus -> pull ->
+        # compute-task message).  home-depth "inf" stacks everything
+        # worker-side (no parking at all) — safe because home-placed
+        # tasks are exempt from stealing (ts.homed) and the drift check
+        # above still sheds load when the home falls behind.
+        if self.home_depth is None:
+            depth = float("inf")
+        else:
+            import math as _math
+
+            sat = state.WORKER_SATURATION
+            depth = (
+                _math.ceil(ws.nthreads * sat) if _math.isfinite(sat)
+                else 2 * ws.nthreads
+            ) + self.home_depth * ws.nthreads
+        if len(ws.processing) < depth:
+            del self.plan[ts.key]
+            self.plan_hits += 1
+            ts.homed = follow_key is None
+            return "hit", ws
+        self.plan_parks += 1
+        return "park", ws
 
     def _miss(self, ts: "TaskState", reason: str):
         self.plan.pop(ts.key, None)
@@ -347,17 +361,16 @@ class JaxPlacement:
             self.hint_drops["stale-dropped"] += before - len(self.plan)
         # plan only runnable *pending* tasks whose dependencies are inside
         # the batch (external deps already sit on specific workers: the
-        # python locality oracle is the right tool for those few), and
-        # skip root-ish tasks — the rootish co-assignment paths never
-        # consult the placement hook
+        # python locality oracle is the right tool for those few).
+        # Rootish tasks ARE planned: the partitioner co-assigns a tile's
+        # sources with the tile, so inputs are born where they are
+        # consumed instead of round-robined by rootish co-assignment.
         batch: list[TaskState] = []
         keyset = set(tasks)
         for ts in tasks.values():
             if ts.run_spec is None or ts.actor or ts.has_restrictions:
                 continue
             if ts.state not in ("released", "waiting"):
-                continue
-            if state.is_rootish(ts):
                 continue
             if all(dts.key in keyset for dts in ts.dependencies):
                 batch.append(ts)
@@ -366,15 +379,26 @@ class JaxPlacement:
         workers = [ws for ws in state.workers.values()]
         if len(workers) < max(self.min_workers, 2):
             return 0
-        durations, out_bytes = self._snapshot_nodes(state, batch)
+        # PRIORITY order is load-bearing: the partitioner's block init
+        # chunks this axis, and scheduler priorities are depth-first
+        # graph order (graph/order.py) — adjacent tasks are related
+        batch.sort(key=lambda ts: ts.priority or (0,))
+        durations, out_bytes, known_frac = self._snapshot_nodes(state, batch)
         ratio = self.min_transfer_ratio
-        if ratio and float(out_bytes.mean()) / state.bandwidth < (
-            ratio * float(durations.mean())
+        if (
+            ratio
+            and known_frac >= 0.5
+            and float(out_bytes.mean()) / state.bandwidth
+            + state.transfer_latency
+            < ratio * float(durations.mean())
         ):
             # transfers are noise next to compute: locality hints cannot
             # pay for themselves on this graph (and occupancy-aware
             # consumption would discard them anyway) — skip the dispatch
-            # before paying for the edge snapshot
+            # before paying for the edge snapshot.  Only trustworthy
+            # when durations are mostly MEASURED: the 500ms unknown-task
+            # default would otherwise veto planning for every
+            # first-of-its-kind graph exactly when the plan matters.
             return 0
         snapshot = self._snapshot(state, batch, workers, durations, out_bytes)
 
@@ -462,20 +486,25 @@ class JaxPlacement:
 
     @staticmethod
     def _snapshot_nodes(state: "SchedulerState", batch: list):
-        """Per-task cost arrays only — enough for the payoff gate."""
+        """Per-task cost arrays + fraction of MEASURED durations (the
+        payoff gate is meaningless against the unknown-task default)."""
         import numpy as np
 
         n = len(batch)
         durations = np.empty(n, np.float32)
         out_bytes = np.empty(n, np.float32)
+        known = 0
         for i, ts in enumerate(batch):
+            prefix = ts.prefix
+            if prefix is not None and prefix.duration_average >= 0:
+                known += 1
             durations[i] = state.get_task_duration(ts)
             nbytes = ts.nbytes
-            if nbytes < 0 and ts.prefix is not None and ts.prefix.nbytes_total:
-                counts = sum(ts.prefix.state_counts.values()) or 1
-                nbytes = ts.prefix.nbytes_total / counts
+            if nbytes < 0 and prefix is not None and prefix.nbytes_total:
+                counts = sum(prefix.state_counts.values()) or 1
+                nbytes = prefix.nbytes_total / counts
             out_bytes[i] = nbytes if nbytes and nbytes > 0 else _DEFAULT_NBYTES
-        return durations, out_bytes
+        return durations, out_bytes, known / max(n, 1)
 
     def _snapshot(self, state: "SchedulerState", batch: list, workers: list,
                   durations, out_bytes):
@@ -501,25 +530,80 @@ class JaxPlacement:
             keys, durations, out_bytes,
             np.asarray(src, np.int32), np.asarray(dst, np.int32),
             nthreads, occupancy, running, addrs, state.bandwidth,
+            state.transfer_latency,
         )
 
     @staticmethod
     def _plan_from_arrays(keys, durations, out_bytes, src, dst, nthreads,
-                          occupancy, running, addrs, bandwidth):
-        """Pack + place on pure arrays — safe to run off-loop.
+                          occupancy, running, addrs, bandwidth,
+                          transfer_latency=0.0):
+        """Plan on pure arrays — safe to run off-loop.
 
-        Returns ``{key: (follow_dep_key | None, addr)}``.  A
-        locality-chosen placement is encoded as FOLLOW-THIS-DEPENDENCY,
-        not as an absolute worker address: ``decide_worker`` resolves the
-        dep's CURRENT holder at consume time, so a hint stays valid even
-        when upstream placements drifted from the plan (an absolute
-        address dies with the first upstream deviation and the
-        invalidation cascades down the whole graph — measured at 84% of
-        all misses on the rechunk+tensordot bench).  Spread placements
-        (choice 2) keep the planned address: their content IS the
-        global load-balance assignment.
+        Two device engines compose here (ops/partition.py docstring has
+        the measurements):
+
+        - ``ops.partition`` (preferred while T·W fits the dense score
+          matrix): comm-volume partitioning over the priority axis,
+          emitted as ABSOLUTE home hints ``(None, addr)`` — the park/
+          pull consumption keeps whole tiles together, which is the
+          point; drift tolerance comes from the backlog checks at
+          consume time, not from re-resolution.
+        - ``ops.leveled`` (the million-task fallback): wave-synchronous
+          placement following heavy dependencies.  A locality choice is
+          encoded FOLLOW-THIS-DEPENDENCY, not as an absolute address:
+          ``resolve`` finds the dep's CURRENT holder at consume time, so
+          a hint survives upstream drift (absolute addresses died with
+          the first upstream deviation and the invalidation cascaded —
+          measured at 84% of all misses on the rechunk+tensordot bench).
+          Spread placements (choice 2) keep the planned address: their
+          content IS the global load-balance assignment.
         """
         import numpy as np
+
+        from distributed_tpu.ops import partition as part
+
+        engine = config.get("scheduler.jax.partitioner")
+        run_idx = np.flatnonzero(running)
+        n_running = len(run_idx)
+        T = len(keys)
+        # load-balance durations on the nthreads-weighted axis: a
+        # 2-thread worker should receive twice the work.  The
+        # partitioner treats workers as equal bins, so spread the label
+        # space: worker w appears nthreads[w] times and the labels fold
+        # back at the end.  The dense-score cap must count LANES (and
+        # the pow2 padding of T), not workers — the score matrix is
+        # T_padded x lanes.
+        lanes: list[int] = []
+        for wi in run_idx:
+            lanes.extend([int(wi)] * max(int(nthreads[wi]), 1))
+        if (
+            engine in ("auto", "numpy")
+            and n_running >= 2
+            and part._bucket(T) * len(lanes) <= part.DENSE_LIMIT
+        ):
+            weights = (
+                out_bytes[src] / bandwidth + transfer_latency
+            ).astype(np.float32)
+            if engine == "numpy" or not part.jax_available():
+                labels = part.partition_numpy(
+                    durations, weights, src, dst, len(lanes)
+                )
+            else:
+                try:
+                    labels = part.partition_padded(
+                        durations, weights, src, dst, len(lanes)
+                    )
+                except Exception:
+                    logger.exception(
+                        "jax partitioner failed; numpy fallback"
+                    )
+                    labels = part.partition_numpy(
+                        durations, weights, src, dst, len(lanes)
+                    )
+            return {
+                key: (None, addrs[lanes[int(labels[i])]])
+                for i, key in enumerate(keys)
+            }
 
         from distributed_tpu.ops.leveled import pack_graph, place_graph_leveled
 

@@ -743,6 +743,14 @@ class Scheduler(Server):
             self.state.running.discard(ws)
             self.state.idle.pop(ws.address, None)
             self.state.idle_task_count.discard(ws)
+            # home-stacked tasks on a paused worker become stealable
+            # again — nothing else would move them off a stalled home
+            steal = self.state.extensions.get("stealing")
+            for ts in ws.processing:
+                if ts.homed:
+                    ts.homed = False
+                    if steal is not None:
+                        steal.put_key_in_stealable(ts)
             # a paused home can't pull: return its parked tasks to the
             # global pop heap and let open slots elsewhere take them
             if ws.address in self.state.parked:

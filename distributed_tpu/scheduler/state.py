@@ -197,6 +197,7 @@ class TaskState:
         "annotations",
         "run_id",
         "queueable",
+        "homed",
         "_rootish",
     )
 
@@ -233,6 +234,10 @@ class TaskState:
         self.annotations: dict | None = None
         self.run_id: int | None = None
         self.queueable = True
+        # placed on its plan-assigned home worker: exempt from stealing
+        # (the balancer scattering a co-assigned tile undoes the plan's
+        # whole point); cleared on processing exit and on home pause
+        self.homed = False
         self._rootish: bool | None = None
 
     def __repr__(self) -> str:
@@ -684,6 +689,28 @@ class SchedulerState:
             assert not ts.who_has
             assert not ts.exception_blame
             assert not ts.processing_on
+        # planned tasks — rootish included: the partitioner co-assigns a
+        # tile's SOURCES with the tile, so its inputs are born home
+        # instead of round-robined by co-assignment and fetched once per
+        # consuming worker
+        if (
+            self.placement is not None
+            and not ts.actor
+            and self.placement.wants(ts)
+        ):
+            verdict, pws = self.placement.resolve(
+                self, ts, self._valid_or_running(ts)
+            )
+            if verdict == "park":
+                # defer for the home worker's next slot-open: the
+                # task queues scheduler-side and the home worker
+                # pulls it via stimulus_queue_slots_maybe_opened
+                self.park_task(ts, pws)
+                return {ts.key: "queued"}, {}, {}
+            if verdict == "hit":
+                worker_msgs = self._add_to_processing(ts, pws, stimulus_id)
+                self._count_transition(ts, "waiting", "processing")
+                return {}, {}, worker_msgs
         if self.is_rootish(ts):
             if math_isfinite(self.WORKER_SATURATION) and ts.queueable:
                 if not (ws := self.decide_worker_rootish_queuing_enabled()):
@@ -692,24 +719,6 @@ class SchedulerState:
                 if not (ws := self.decide_worker_rootish_queuing_disabled(ts)):
                     return {ts.key: "no-worker"}, {}, {}
         else:
-            if (
-                self.placement is not None
-                and not ts.actor
-                and self.placement.wants(ts)
-            ):
-                verdict, pws = self.placement.resolve(
-                    self, ts, self._valid_or_running(ts)
-                )
-                if verdict == "park":
-                    # defer for the home worker's next slot-open: the
-                    # task queues scheduler-side and the home worker
-                    # pulls it via stimulus_queue_slots_maybe_opened
-                    self.park_task(ts, pws)
-                    return {ts.key: "queued"}, {}, {}
-                if verdict == "hit":
-                    worker_msgs = self._add_to_processing(ts, pws, stimulus_id)
-                    self._count_transition(ts, "waiting", "processing")
-                    return {}, {}, worker_msgs
             if not (ws := self.decide_worker_non_rootish(ts)):
                 if ts.waiting_on:
                     # A dependency's last replica vanished between the
@@ -1316,6 +1325,7 @@ class SchedulerState:
         ws = ts.processing_on
         assert ws is not None
         ts.processing_on = None
+        ts.homed = False
         duration = ws.processing.pop(ts, 0.0)
         was_long_running = ts in ws.long_running
         ws.long_running.discard(ts)

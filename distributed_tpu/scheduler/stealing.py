@@ -157,6 +157,13 @@ class WorkStealing:
     def put_key_in_stealable(self, ts: "TaskState") -> None:
         if ts.processing_on is None:
             return
+        if ts.homed:
+            # placed on its plan-assigned home: stealing a co-assigned
+            # tile apart undoes the partition plan (measured: with deep
+            # home stacks stealable, peer fetches tripled back to the
+            # no-plan level).  Drift is shed by the placement resolve's
+            # backlog-outlier check, not by the balancer.
+            return
         cost, level = self.steal_time_ratio(ts)
         if cost is None:
             return

@@ -135,6 +135,17 @@ class Worker(Server):
             1, thread_name_prefix="dtpu-worker-actor"
         )
         self.batched_stream = BatchedSend()
+        self._stream_event_buffer: list[StateMachineEvent] = []
+        self._stream_flush_scheduled = False
+        # inline fast path: per-prefix EMA of IN-THREAD task duration
+        # (measured around the bare fn call, executor overhead excluded)
+        # + a loop-budget window so inlining can never starve the loop
+        self._inline_threshold = config.parse_timedelta(
+            config.get("worker.inline-threshold") or "0"
+        )
+        self._prefix_inner_ema: dict[str, float] = {}
+        self._inline_window_t0 = 0.0
+        self._inline_spent = 0.0
         # cumulative peer-serve counters (observability + benchmarks:
         # placement quality shows up directly as fewer get_data serves)
         self.get_data_requests = 0
@@ -560,6 +571,27 @@ class Worker(Server):
 
     # ------------------------------------------------------ stream handlers
 
+    def _enqueue_stream_event(self, event: StateMachineEvent) -> None:
+        """Coalesce stream stimuli within one payload: every message of
+        a scheduler payload (often a whole planned tile of compute-tasks)
+        lands in ONE handle_stimulus batch, so the state machine's
+        communicating drain can aggregate their dep fetches into few
+        GatherDep requests.  ``handle_stream`` flushes SYNCHRONOUSLY at
+        each payload boundary (rpc/core.py stream_payload_flush), so no
+        locally-generated event can interleave mid-payload; the
+        call_soon is only a backstop for direct calls outside a stream
+        (tests, debugging)."""
+        self._stream_event_buffer.append(event)
+        if not self._stream_flush_scheduled:
+            self._stream_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self.stream_payload_flush)
+
+    def stream_payload_flush(self) -> None:
+        self._stream_flush_scheduled = False
+        events, self._stream_event_buffer = self._stream_event_buffer, []
+        if events:
+            self.handle_stimulus(*events)
+
     def _stream_compute_task(self, **msg: Any) -> None:
         msg.pop("op", None)
         msg["run_spec"] = unwrap(msg.get("run_spec"))
@@ -569,13 +601,15 @@ class Worker(Server):
             k: v for k, v in msg.items()
             if k in fields and (v is not None or k in ("run_spec", "span_id"))
         }
-        self.handle_stimulus(ComputeTaskEvent(**msg))
+        self._enqueue_stream_event(ComputeTaskEvent(**msg))
 
     def _stream_free_keys(self, keys: tuple = (), stimulus_id: str = "") -> None:
-        self.handle_stimulus(FreeKeysEvent(stimulus_id=stimulus_id, keys=tuple(keys)))
+        self._enqueue_stream_event(
+            FreeKeysEvent(stimulus_id=stimulus_id, keys=tuple(keys))
+        )
 
     def _stream_remove_replicas(self, keys: tuple = (), stimulus_id: str = "") -> None:
-        self.handle_stimulus(
+        self._enqueue_stream_event(
             RemoveReplicasEvent(stimulus_id=stimulus_id, keys=tuple(keys))
         )
 
@@ -583,18 +617,20 @@ class Worker(Server):
         self, who_has: dict | None = None, nbytes: dict | None = None,
         stimulus_id: str = "",
     ) -> None:
-        self.handle_stimulus(
+        self._enqueue_stream_event(
             AcquireReplicasEvent(
                 stimulus_id=stimulus_id, who_has=who_has or {}, nbytes=nbytes or {}
             )
         )
 
     def _stream_steal_request(self, key: Key = "", stimulus_id: str = "") -> None:
-        self.handle_stimulus(StealRequestEvent(stimulus_id=stimulus_id, key=key))
+        self._enqueue_stream_event(
+            StealRequestEvent(stimulus_id=stimulus_id, key=key)
+        )
 
     def _stream_refresh_who_has(self, who_has: dict | None = None,
                                 stimulus_id: str = "") -> None:
-        self.handle_stimulus(
+        self._enqueue_stream_event(
             RefreshWhoHasEvent(
                 stimulus_id=stimulus_id or seq_name("refresh"), who_has=who_has or {}
             )
@@ -607,9 +643,9 @@ class Worker(Server):
 
     def _stream_status_change(self, status: str = "", stimulus_id: str = "") -> None:
         if status == "paused":
-            self.handle_stimulus(PauseEvent(stimulus_id=stimulus_id))
+            self._enqueue_stream_event(PauseEvent(stimulus_id=stimulus_id))
         elif status == "running":
-            self.handle_stimulus(UnpauseEvent(stimulus_id=stimulus_id))
+            self._enqueue_stream_event(UnpauseEvent(stimulus_id=stimulus_id))
 
     # ------------------------------------------------- instruction execution
 
@@ -684,6 +720,15 @@ class Worker(Server):
         if unit == "seconds":
             self.digest_metric(f"{context}-{label}-seconds", value)
 
+    def _note_inner_duration(self, prefix: str, dur: float) -> None:
+        """EMA of the bare in-thread fn duration per prefix (the inline
+        fast-path gate).  Called from executor threads and the loop; a
+        lost update under the GIL is harmless for an EMA."""
+        ema = self._prefix_inner_ema.get(prefix)
+        self._prefix_inner_ema[prefix] = (
+            dur if ema is None else 0.7 * ema + 0.3 * dur
+        )
+
     async def _execute(self, key: Key, stimulus_id: str) -> StateMachineEvent | None:
         """Run one task (reference worker.py:2210)."""
         ts = self.state.tasks.get(key)
@@ -713,29 +758,61 @@ class Worker(Server):
                         reset_async_worker(token)
                 else:
                     import contextvars
+                    from time import perf_counter as _perf
 
                     from distributed_tpu.utils.misc import key_split
                     from distributed_tpu.worker.context import set_thread_worker
                     from distributed_tpu.worker.metrics import context_meter
 
+                    prefix = key_split(key)
+
                     def _user_metric(label, value, unit,
-                                     _sid=ts.span_id, _pre=key_split(key)):
+                                     _sid=ts.span_id, _pre=prefix):
                         self._fine_metric(
                             "execute", _sid, _pre, label, unit, value
                         )
 
-                    def _call(fn=fn, args=args, kwargs=kwargs):
+                    def _call(fn=fn, args=args, kwargs=kwargs, _pre=prefix):
                         set_thread_worker(self, key)
-                        return fn(*args, **kwargs)
+                        t0 = _perf()
+                        try:
+                            return fn(*args, **kwargs)
+                        finally:
+                            self._note_inner_duration(_pre, _perf() - t0)
 
-                    # context_meter callbacks installed here flow into the
-                    # fine metrics; copy_context propagates them into the
-                    # executor thread so user task code can emit samples
-                    with context_meter.add_callback(_user_metric):
-                        ctx = contextvars.copy_context()
-                        value = await asyncio.get_running_loop().run_in_executor(
-                            self.executor, ctx.run, _call
-                        )
+                    inline = False
+                    if not ts.actor and self._inline_threshold:
+                        ema = self._prefix_inner_ema.get(prefix)
+                        if ema is not None and ema < self._inline_threshold:
+                            nowp = _perf()
+                            if nowp - self._inline_window_t0 > 0.02:
+                                self._inline_window_t0 = nowp
+                                self._inline_spent = 0.0
+                            inline = self._inline_spent < 0.005
+                    if inline:
+                        # known-tiny task: the executor handoff costs
+                        # more loop work than the function itself
+                        t0 = _perf()
+                        try:
+                            with context_meter.add_callback(_user_metric):
+                                value = _call()
+                        finally:
+                            # _call installed a thread-local task key —
+                            # on the LOOP thread here; clear it or every
+                            # later coroutine task on the loop reads the
+                            # stale key via get_task_key()
+                            set_thread_worker(None, None)
+                        self._inline_spent += _perf() - t0
+                    else:
+                        # context_meter callbacks installed here flow
+                        # into the fine metrics; copy_context propagates
+                        # them into the executor thread so user task
+                        # code can emit samples
+                        with context_meter.add_callback(_user_metric):
+                            ctx = contextvars.copy_context()
+                            value = await asyncio.get_running_loop().run_in_executor(
+                                self.executor, ctx.run, _call
+                            )
                 if ts.actor:
                     # keep the instance resident; the task's value is a
                     # placeholder resolved to an Actor proxy client-side

@@ -133,14 +133,18 @@ async def test_placement_disabled_by_flag():
 
 
 def test_hint_resolution_hit_park_yield():
-    """Three-verdict hint consumption: open slot -> hit; home stacked but
-    in line with the cluster-average backlog -> park (the home pulls it
-    at its next slot-open); home an outlier vs the average -> yield to
-    an idle worker unless the transfer cost it avoids outweighs the wait
-    (worker_objective semantics with the fixed per-fetch latency)."""
+    """Three-verdict hint consumption (finite home-depth): open slot ->
+    hit; home stacked to depth but backlog in line with the cluster
+    average -> park (the home pulls it at its next slot-open); home an
+    extreme backlog outlier with a tiny dep -> yield to an idle worker;
+    a huge dep keeps the task bound to its home (park) even then."""
+    from distributed_tpu import config
     from distributed_tpu.scheduler.state import SchedulerState
 
-    state = SchedulerState(validate=True)
+    with config.set({"scheduler.jax.home-depth": 0,
+                     "scheduler.jax.drift-yield": True}):
+        state = SchedulerState(validate=True)
+        placement = JaxPlacement(min_batch=1, min_workers=0, sync=True)
     busy = state.add_worker_state("tcp://h:1", nthreads=1, memory_limit=2**30)
     idle = state.add_worker_state("tcp://h:2", nthreads=1, memory_limit=2**30)
     state.check_idle_saturated(busy)
@@ -153,18 +157,17 @@ def test_hint_resolution_hit_park_yield():
     ts = state.new_task("child-1", None, "released")
     ts.add_dependency(dep)
 
-    placement = JaxPlacement(min_batch=1, min_workers=0, sync=True)
-
     # open slot on the home -> immediate hit, no second-guessing
     placement.plan = {ts.key: (dep.key, busy.address)}
     verdict, ws = placement.resolve(state, ts, None)
     assert (verdict, ws) == ("hit", busy)
     assert placement.plan_hits == 1
 
-    # fill the home's stack beyond the accepted depth
+    # fill the home's stack to the accepted depth (home-depth=0 ->
+    # ceil(nthreads*saturation) = 2)
     import math
 
-    depth = math.ceil(busy.nthreads * state.WORKER_SATURATION) + busy.nthreads
+    depth = math.ceil(busy.nthreads * state.WORKER_SATURATION)
     for i in range(depth):
         filler = state.new_task(f"filler-{i}", None, "released")
         busy.processing[filler] = 0.001
@@ -182,8 +185,8 @@ def test_hint_resolution_hit_park_yield():
     assert placement.plan_parks == 1
     assert ts.key in placement.plan  # hint kept for the later pull
 
-    # home an OUTLIER vs the average + tiny dep: waiting behind 10s of
-    # queue to save a 1-byte transfer is absurd -> yield (miss)
+    # home an EXTREME outlier vs the average + tiny dep: waiting behind
+    # 10s of queue to save a 1-byte transfer is absurd -> yield (miss)
     busy.occupancy = 10.0
     state._total_occupancy = 10.0
     placement.plan = {ts.key: (dep.key, busy.address)}
@@ -192,8 +195,8 @@ def test_hint_resolution_hit_park_yield():
     assert placement.plan_misses == 1
 
     # huge dep (100s at the configured bandwidth): locality beats the
-    # 10s queue -> hint holds even on an outlier home
+    # 10s queue -> the task stays bound to its home and parks for it
     dep.nbytes = int(state.bandwidth * 100)
     placement.plan = {ts.key: (dep.key, busy.address)}
     verdict, ws = placement.resolve(state, ts, None)
-    assert (verdict, ws) == ("hit", busy)
+    assert (verdict, ws) == ("park", busy)

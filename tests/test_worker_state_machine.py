@@ -396,6 +396,11 @@ def test_compute_cancel_recompute_before_first_tick():
         from distributed_tpu.worker.metrics import FineMetrics
 
         w.fine_metrics = FineMetrics()
+        # inline fast-path state normally set in Worker.__init__
+        w._inline_threshold = 0.0
+        w._prefix_inner_ema = {}
+        w._inline_window_t0 = 0.0
+        w._inline_spent = 0.0
 
         # 1. compute-task -> Execute instruction (coroutine created but
         #    not yet ticked)
