@@ -586,9 +586,27 @@ class WorkerState:
                 else:
                     ts.waiting_for_data.add(dts)
                     dts.waiters.add(ts)
-                    if dts.state not in FETCH_STATES and dts.state != "missing":
+                    if dts.state not in FETCH_STATES and dts.state not in (
+                        "missing",
+                        # locally QUEUED to (re)compute: recommending a
+                        # fetch would route ready->released->fetch and
+                        # discard the scheduler-assigned local compute —
+                        # wait for _put_memory like any local producer
+                        "ready", "constrained", "waiting",
+                    ):
                         recs[dts] = "fetch"
-            elif dts.state == "flight":
+            elif dts.state in ("flight", "executing", "long-running"):
+                # the dep's data isn't here yet in EITHER case: in
+                # flight from a peer, or being (re)computed locally — a
+                # freed-then-recomputed dep races exactly like a fetch
+                # (found by the tcp race suite: the dependent went
+                # waiting->ready with the dep still executing and no
+                # data, tripping the ready invariant).  If the local
+                # execution ERRS instead, the scheduler's erred cascade
+                # frees this dependent (it has the dep as processing
+                # here, so the task-erred report is never fenced) and
+                # generic_released clears waiting_for_data — same
+                # resolution as a flight dep whose gather fails.
                 ts.waiting_for_data.add(dts)
                 dts.waiters.add(ts)
         recs[ts] = "waiting"
@@ -979,7 +997,12 @@ class WorkerState:
     def _transition_waiting_ready(self, ts, *, stimulus_id):
         if self.validate:
             assert not ts.waiting_for_data, ts
-            assert all(d.key in self.data for d in ts.dependencies), ts
+            assert all(d.key in self.data for d in ts.dependencies), (
+                ts,
+                [(d.key, d.state, d.key in self.data)
+                 for d in ts.dependencies],
+                list(self.stimulus_log)[-8:],
+            )
         ts.state = "ready"
         self.ready.add(ts)
         return {}, []
