@@ -54,6 +54,16 @@ def make_parser() -> argparse.ArgumentParser:
                    help="CA certificate for TLS (tls:// scheduler address)")
     p.add_argument("--tls-cert", default=None, help="worker TLS certificate")
     p.add_argument("--tls-key", default=None, help="worker TLS private key")
+    p.add_argument("--jax-coordinator", default=None,
+                   help="host:port of the jax.distributed coordination "
+                        "service — joins this process to a pod-wide jax "
+                        "runtime for the device data plane")
+    p.add_argument("--jax-process-id", type=int, default=None,
+                   help="this process's index in the pod (0..n-1)")
+    p.add_argument("--jax-num-processes", type=int, default=None,
+                   help="total jax processes in the pod")
+    p.add_argument("--jax-cpu-devices", type=int, default=None,
+                   help="virtual CPU devices per process (testing)")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--version", action="store_true")
     return p
@@ -61,6 +71,28 @@ def make_parser() -> argparse.ArgumentParser:
 
 async def run(args: argparse.Namespace) -> int:
     import os
+
+    if args.jax_coordinator and not args.nanny:
+        # join the pod-wide jax runtime FIRST: both the device-count
+        # config and jax.distributed.initialize must run before ANY
+        # backend query in this process (imports below may touch jax).
+        # Under --nanny the PARENT must NOT join — the nanny-spawned
+        # worker child joins with this process_id; a double-join wedges
+        # the coordination service (the child gets the kwargs below).
+        import jax
+
+        from distributed_tpu.ops.partition import _pin_cpu_if_requested
+
+        _pin_cpu_if_requested(jax)
+        if args.jax_cpu_devices:
+            jax.config.update("jax_num_cpu_devices", args.jax_cpu_devices)
+        from distributed_tpu.parallel import multihost
+
+        multihost.maybe_initialize(
+            args.jax_coordinator,
+            process_id=args.jax_process_id,
+            num_processes=args.jax_num_processes,
+        )
 
     from distributed_tpu.preloading import process_preloads
     from distributed_tpu.utils.system import parse_memory_limit
@@ -135,6 +167,13 @@ async def run(args: argparse.Namespace) -> int:
         worker_kwargs = {}
         if resources:
             worker_kwargs["resources"] = resources
+        if args.jax_coordinator:
+            worker_kwargs.update(
+                jax_coordinator=args.jax_coordinator,
+                jax_process_id=args.jax_process_id,
+                jax_num_processes=args.jax_num_processes,
+                jax_cpu_devices=args.jax_cpu_devices,
+            )
         if listen_addr:
             worker_kwargs["listen_addr"] = listen_addr
         if security is not None:

@@ -45,7 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-F32_INF = jnp.float32(jnp.inf)
+# numpy, NOT jnp: a module-level jnp scalar initializes the XLA backend
+# at import time, which breaks jax.distributed.initialize in pod workers
+# (it must run before the first backend query in the process)
+F32_INF = np.float32(np.inf)
 
 
 class WorkerArrays(NamedTuple):

@@ -1,0 +1,91 @@
+"""Multi-host jax runtime bring-up for worker processes.
+
+The device data plane (``shuffle/device.py``, ``ops/ici.py``) moves jax
+arrays over the mesh interconnect.  On a single host every device is
+addressable from one process; on a POD, each worker process owns a
+subset of chips and the global mesh only exists after
+``jax.distributed.initialize`` wires every process to a coordination
+service — the role NCCL's rendezvous + process groups play for the
+reference's UCX/NCCL backend (reference comm/ucx.py:211 initializes per
+process; distributed_c10d-style bootstrap).
+
+Topology contract (documented in docs/deploy.md):
+
+- one worker process per host (or per chip-group), each started with
+  ``--jax-coordinator host:port --jax-process-id i --jax-num-processes n``
+  (the scheduler host typically runs the coordinator at a fixed port);
+- after initialize, ``jax.devices()`` spans the whole pod while
+  ``jax.local_devices()`` is this process's chips; mesh device i is
+  owned by the process where it is local;
+- device-plane exchanges are SPMD: every participating process enters
+  the same jitted collective with its LOCAL shards (see
+  ``shuffle/device.py`` multihost mode), and XLA runs the all-to-all
+  over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("distributed_tpu.multihost")
+
+_initialized = False
+
+
+def maybe_initialize(
+    coordinator: str | None,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+    local_device_ids: list[int] | None = None,
+) -> bool:
+    """Idempotently bring up ``jax.distributed`` for this process.
+
+    No-op (returns False) when ``coordinator`` is None — single-host
+    deployments never touch jax here.  Must run before the first jax
+    backend query in the process (worker start does this before any
+    task can execute)."""
+    global _initialized
+    if coordinator is None:
+        return False
+    if _initialized:
+        return True
+    import jax
+
+    kwargs: dict = {"coordinator_address": coordinator}
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = [int(x) for x in local_device_ids]
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %s/%s via %s",
+        process_id, num_processes, coordinator,
+    )
+    return True
+
+
+def is_multihost() -> bool:
+    """True when this process participates in a multi-process jax
+    runtime (devices exist that are not addressable locally)."""
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def local_device_indices(n_devices: int | None = None) -> list[int]:
+    """Global mesh indices (= shuffle partition ids) owned by this
+    process: positions of ``jax.local_devices()`` within
+    ``jax.devices()[:n_devices]``."""
+    import jax
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    local = set(d.id for d in jax.local_devices())
+    return [i for i, d in enumerate(devs) if d.id in local]

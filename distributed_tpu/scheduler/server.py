@@ -360,6 +360,10 @@ class Scheduler(Server):
         )
         if kwargs.get("versions"):
             ws.extra["versions"] = kwargs["versions"]
+        if kwargs.get("jax_devices") is not None:
+            # global mesh device indices this worker's process owns —
+            # the device-plane shuffle pins partitions to their owners
+            ws.extra["jax_devices"] = list(kwargs["jax_devices"])
         if kwargs.get("nanny"):
             ws.extra["nanny"] = kwargs["nanny"]
             # late-joining nanny gets the already-registered nanny plugins

@@ -230,16 +230,24 @@ async def merge_unpack(shuffle_id: str, partition_id: int,
 # --------------------------------------------------------- graph builders
 
 async def _create_shuffle(client: Any, shuffle_id: str,
-                          npartitions_out: int, n_inputs: int) -> dict[int, str]:
+                          npartitions_out: int, n_inputs: int,
+                          want_device_owned: bool = False):
     """Register the shuffle with the scheduler extension; returns the
-    initial worker_for map (for unpack restrictions)."""
+    initial worker_for map (for unpack restrictions), or with
+    ``want_device_owned`` a ``(worker_for, device_owned)`` pair —
+    device_owned means worker_for pins partitions to the processes that
+    own the matching global mesh devices (multi-host device plane)."""
     resp = await client.scheduler.shuffle_get_or_create(
-        id=shuffle_id, npartitions_out=npartitions_out, n_inputs=n_inputs
+        id=shuffle_id, npartitions_out=npartitions_out, n_inputs=n_inputs,
+        device=want_device_owned,
     )
     if resp.get("status") != "OK":
         raise RuntimeError(f"shuffle registration failed: {resp!r}")
     spec = resp["spec"]
-    return {int(k): v for k, v in spec["worker_for"].items()}
+    worker_for = {int(k): v for k, v in spec["worker_for"].items()}
+    if want_device_owned:
+        return worker_for, bool(resp.get("device_owned"))
+    return worker_for
 
 
 def _build_pipeline(

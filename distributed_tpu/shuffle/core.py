@@ -54,13 +54,18 @@ class ShuffleSpec:
     shuffle/_core.py:421).  Created by the scheduler extension; run_id is
     the fencing epoch."""
 
-    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for")
+    __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for",
+                 "device_owned")
 
     def __init__(self, id: str, run_id: int, npartitions_out: int,
-                 worker_for: dict[int, str], n_inputs: int | None = None):
+                 worker_for: dict[int, str], n_inputs: int | None = None,
+                 device_owned: bool = False):
         self.id = id
         self.run_id = run_id
         self.npartitions_out = npartitions_out
+        # worker_for pins partitions to pod device owners (multi-host
+        # device plane): the barrier then fans the exchange out SPMD
+        self.device_owned = bool(device_owned)
         # input-partition count is independent of the output fan-out
         # (n_in != n_out shuffles); consumers that need "how many
         # registrations complete the exchange" must use this, never
@@ -78,6 +83,7 @@ class ShuffleSpec:
             "run_id": self.run_id,
             "npartitions_out": self.npartitions_out,
             "n_inputs": self.n_inputs,
+            "device_owned": self.device_owned,
             "worker_for": {str(k): v for k, v in self.worker_for.items()},
         }
 
@@ -87,6 +93,7 @@ class ShuffleSpec:
             msg["id"], msg["run_id"], msg["npartitions_out"],
             {int(k): v for k, v in msg["worker_for"].items()},
             n_inputs=msg.get("n_inputs"),
+            device_owned=msg.get("device_owned", False),
         )
 
 
@@ -302,6 +309,29 @@ class ShuffleWorkerExtension:
         worker.handlers["shuffle_receive"] = self.shuffle_receive
         worker.handlers["shuffle_inputs_done"] = self.shuffle_inputs_done
         worker.handlers["shuffle_fetch_output"] = self.shuffle_fetch_output
+        worker.handlers["device_shuffle_exchange"] = self.device_exchange
+        worker.handlers["device_shuffle_precheck"] = self.device_precheck
+
+    async def device_precheck(self, id: str = "", run_id: int = 0) -> dict:
+        from distributed_tpu.shuffle.device import (
+            device_shuffle_precheck_handler,
+        )
+
+        return await device_shuffle_precheck_handler(
+            self.worker, id=id, run_id=run_id
+        )
+
+    async def device_exchange(self, id: str = "", run_id: int = 0,
+                              max_n: int = 0) -> dict:
+        """Join a device-plane exchange epoch with this process's local
+        shards (multi-host SPMD; shuffle/device.py)."""
+        from distributed_tpu.shuffle.device import (
+            device_shuffle_exchange_handler,
+        )
+
+        return await device_shuffle_exchange_handler(
+            self.worker, id=id, run_id=run_id, max_n=max_n
+        )
 
     def get_or_create(self, spec: ShuffleSpec) -> ShuffleRun:
         run = self.runs.get(spec.id)

@@ -58,6 +58,7 @@ class DeviceRun:
         self.npartitions_out = npartitions_out
         self.parts: dict[int, tuple[Any, Any]] = {}
         self.outputs: dict[int, tuple[Any, Any]] | None = None
+        self.local_ids: list[int] = []
         self.served: set[int] = set()
         self.last_activity = _time.monotonic()
         self.lock = threading.Lock()
@@ -72,12 +73,22 @@ class DeviceRun:
 
     # ----------------------------------------------------------- exchange
 
-    def exchange(self) -> None:
+    def exchange(self, max_n: int | None = None) -> None:
         """Run the mesh all-to-all once; idempotent per epoch.
 
-        Requires every partition registered (the barrier task's graph
-        dependencies guarantee it).  Partitions are placed one-per-device
-        on a 1-D mesh; ragged lengths are padded to a common local size
+        SPMD-by-construction: this process contributes shards only for
+        its LOCAL mesh devices (``self.parts`` — in a multi-host pod the
+        transfer tasks are pinned to device owners, so each process's
+        store holds exactly its own partitions) and slices outputs only
+        for local devices.  On a single host "local" is all of them and
+        this degenerates to the one-process exchange.  In a pod, every
+        participating process must call this concurrently (the barrier
+        fans out ``device_shuffle_exchange`` RPCs) so the jitted
+        collective can rendezvous across hosts.
+
+        ``max_n``: the GLOBAL max partition length (from the transfer
+        results via the barrier); required in multi-host mode where no
+        process sees every partition.  Ragged lengths are padded to it
         and masked out of the exchange (``valid``), so no padding row
         ever crosses the interconnect as data.
         """
@@ -91,20 +102,30 @@ class DeviceRun:
             self.touch()
             if self.outputs is not None:
                 return
-            if len(self.parts) != self.n_inputs:
-                raise RuntimeError(
-                    f"device shuffle {self.id} run {self.run_id}: "
-                    f"{len(self.parts)}/{self.n_inputs} partitions registered"
-                )
             n_dev = self.n_inputs
             mesh = make_mesh_1d(n_dev)
             devices = list(mesh.devices.flat)
-            max_n = max(int(k.shape[0]) for k, _ in self.parts.values())
-            max_n = max(max_n, 1)
+            local_ids = [
+                d for d in range(n_dev)
+                if devices[d].process_index == jax.process_index()
+            ]
+            if set(self.parts) != set(local_ids):
+                raise RuntimeError(
+                    f"device shuffle {self.id} run {self.run_id}: "
+                    f"registered partitions {sorted(self.parts)} != local "
+                    f"mesh devices {local_ids}"
+                )
+            if max_n is None:
+                # single-host callers: every length is visible here
+                max_n = max(
+                    (int(k.shape[0]) for k, _ in self.parts.values()),
+                    default=1,
+                )
+            max_n = max(int(max_n), 1)
             val_shape = next(iter(self.parts.values()))[1].shape[1:]
 
             k_shards, v_shards, m_shards = [], [], []
-            for d in range(n_dev):
+            for d in local_ids:
                 keys, values = self.parts[d]
                 n = int(keys.shape[0])
                 keys = jnp.asarray(keys, jnp.int32)
@@ -126,6 +147,8 @@ class DeviceRun:
                 m_shards.append(jax.device_put(mask, devices[d]))
 
             sharding = NamedSharding(mesh, P("shuffle"))
+            # make_array_from_single_device_arrays needs only the
+            # ADDRESSABLE shards — the other processes supply theirs
             K = jax.make_array_from_single_device_arrays(
                 (n_dev * max_n,), sharding, k_shards
             )
@@ -140,23 +163,37 @@ class DeviceRun:
             ko, vo, counts, _sent = shuffle_on_mesh(
                 mesh, K, V, capacity=max_n, valid=M
             )
-            # counts are control data: the ONLY bytes that touch the host
-            cnt = np.asarray(counts).reshape(n_dev, n_dev)
-            if (cnt > max_n).any():  # pragma: no cover - capacity==max_n
-                raise RuntimeError("device shuffle truncated a block")
+            # counts are control data: the ONLY bytes that touch the
+            # host — read per-shard (never np.asarray the global array:
+            # it is not fully addressable in a pod)
+            cnt_by_dev: dict[int, Any] = {}
+            for shard in counts.addressable_shards:
+                d = shard.index[0].start // n_dev
+                cnt_by_dev[d] = np.asarray(shard.data)
+            k_by_dev = {
+                devices.index(s.device): s.data
+                for s in ko.addressable_shards
+            }
+            v_by_dev = {
+                devices.index(s.device): s.data
+                for s in vo.addressable_shards
+            }
 
             outputs: dict[int, tuple[Any, Any]] = {}
-            for d in range(n_dev):
-                # device d's receive buffers: rows [d*n_dev, (d+1)*n_dev)
-                kshard = ko.addressable_shards[d].data  # [n_dev, max_n]
-                vshard = vo.addressable_shards[d].data
-                kparts = [kshard[s, : int(cnt[d, s])] for s in range(n_dev)]
-                vparts = [vshard[s, : int(cnt[d, s])] for s in range(n_dev)]
+            for d in local_ids:
+                cnt = cnt_by_dev[d]
+                if (cnt > max_n).any():  # pragma: no cover - cap==max_n
+                    raise RuntimeError("device shuffle truncated a block")
+                kshard = k_by_dev[d]  # [n_dev, max_n] rows for dest d
+                vshard = v_by_dev[d]
+                kparts = [kshard[s, : int(cnt[s])] for s in range(n_dev)]
+                vparts = [vshard[s, : int(cnt[s])] for s in range(n_dev)]
                 outputs[d] = (
                     jnp.concatenate(kparts) if kparts else kshard[:0],
                     jnp.concatenate(vparts) if vparts else vshard[:0],
                 )
             self.outputs = outputs
+            self.local_ids = list(local_ids)
 
 
 class DeviceShuffleStore:
@@ -255,7 +292,10 @@ class DeviceShuffleStore:
             run.served.add(int(pid))
             # inputs are dead weight as soon as the exchange ran
             run.parts.clear()
-            if len(run.served) >= run.npartitions_out:
+            # collect once every LOCAL output left for worker memory —
+            # in a pod this process only ever serves its own devices
+            n_local = len(run.local_ids) or run.npartitions_out
+            if len(run.served) >= n_local:
                 self.runs.pop((run.id, run.run_id), None)
                 key = (run.id, run.run_id)
                 if key not in self._done_set:
@@ -287,8 +327,10 @@ async def _spec_for(shuffle_id: str):
 
 
 async def device_shuffle_transfer(data: Any, shuffle_id: str,
-                                  partition_id: int) -> int:
-    """Register one device partition; zero data movement."""
+                                  partition_id: int) -> tuple[int, int]:
+    """Register one device partition; zero data movement.  Returns
+    ``(partition_id, n_rows)`` — the barrier needs the GLOBAL max
+    length to size the exchange when no process sees every partition."""
     worker, run = await _spec_for(shuffle_id)
     keys, values = data
     store_run = device_store().get_or_create(
@@ -297,14 +339,108 @@ async def device_shuffle_transfer(data: Any, shuffle_id: str,
     )
     if store_run is not None:  # None: duplicate rerun of a finished epoch
         store_run.register(partition_id, keys, values)
-    return partition_id
+    return int(partition_id), int(keys.shape[0])
+
+
+async def device_shuffle_exchange_handler(worker: Any, id: str = "",
+                                          run_id: int = 0,
+                                          max_n: int = 0) -> dict:
+    """Worker RPC: enter this epoch's mesh exchange with OUR local
+    shards.  In a pod every participant must be inside the jitted
+    collective together — the barrier fans this out concurrently and
+    the per-process executions rendezvous in XLA."""
+    run = await worker.shuffle.get_or_create_remote(id)
+    if run.run_id != run_id:
+        return {"status": "stale", "run_id": run.run_id}
+    store_run = device_store().get_or_create(
+        id, run_id, run.spec.n_inputs, run.spec.npartitions_out,
+    )
+    if store_run is None:
+        return {"status": "done"}
+    await asyncio.get_running_loop().run_in_executor(
+        None, store_run.exchange, max_n
+    )
+    return {"status": "OK"}
+
+
+async def device_shuffle_precheck_handler(worker: Any, id: str = "",
+                                          run_id: int = 0) -> dict:
+    """Worker RPC: confirm this process is on the SAME epoch with all
+    of its local partitions registered, WITHOUT entering the collective.
+    The barrier runs this all-or-nothing round first — one participant
+    skipping the exchange (stale epoch) while the others are already
+    blocked inside the cross-host collective would wedge them forever."""
+    run = await worker.shuffle.get_or_create_remote(id)
+    if run.run_id != run_id:
+        return {"status": "stale", "run_id": run.run_id}
+    store_run = device_store().runs.get((id, run_id))
+    if store_run is None:
+        return {"status": "no-parts"}
+    return {"status": "OK", "n_parts": len(store_run.parts)}
 
 
 async def device_shuffle_barrier(shuffle_id: str,
-                                 *transfer_results: int) -> int:
-    """Scheduler-fenced barrier, then the one-shot mesh exchange."""
+                                 *transfer_results) -> int:
+    """Scheduler-fenced barrier, then the mesh exchange.
+
+    Single-host: one exchange call covers all devices.  Multi-host
+    pod (``spec.device_owned``): precheck every participant is on this
+    epoch, then fan the exchange out so each process joins the
+    collective with its local shards."""
     worker, run = await _spec_for(shuffle_id)
     await run.barrier()
+    max_n = max((int(n) for _, n in transfer_results), default=1)
+    participants = set(run.spec.worker_for.values())
+    if len(participants) > 1 and _multihost():
+        if not run.spec.device_owned:
+            # overlapping/non-covering device ownership (e.g. several
+            # worker processes sharing one jax runtime): registrations
+            # are scattered across processes and no SPMD exchange can
+            # assemble them.  Fail loudly with the remedy.
+            raise RuntimeError(
+                "device shuffle on a multi-process pod requires "
+                "device-owned placement: start ONE worker process per "
+                "chip group with --jax-coordinator/--jax-process-id so "
+                "ownership is disjoint (got round-robin worker_for)"
+            )
+        timeout = 120.0
+
+        async def call(addr: str, op: str):
+            if addr == worker.address:
+                fn = (device_shuffle_exchange_handler if op == "exchange"
+                      else device_shuffle_precheck_handler)
+                kwargs = {"id": shuffle_id, "run_id": run.run_id}
+                if op == "exchange":
+                    kwargs["max_n"] = max_n
+                return await fn(worker, **kwargs)
+            peer = worker.rpc(addr)
+            if op == "exchange":
+                return await peer.device_shuffle_exchange(
+                    id=shuffle_id, run_id=run.run_id, max_n=max_n
+                )
+            return await peer.device_shuffle_precheck(
+                id=shuffle_id, run_id=run.run_id
+            )
+
+        addrs = sorted(participants)
+        pre = await asyncio.wait_for(
+            asyncio.gather(*(call(a, "precheck") for a in addrs)), timeout
+        )
+        bad = [
+            (a, r) for a, r in zip(addrs, pre) if r.get("status") != "OK"
+        ]
+        if bad:
+            raise RuntimeError(f"device exchange precheck failed: {bad!r}")
+        # every process now enters the collective together; the timeout
+        # turns a wedged rendezvous (participant died between rounds)
+        # into a barrier error -> epoch restart instead of a hang
+        results = await asyncio.wait_for(
+            asyncio.gather(*(call(a, "exchange") for a in addrs)), timeout
+        )
+        bad = [r for r in results if r.get("status") not in ("OK", "done")]
+        if bad:
+            raise RuntimeError(f"device exchange failed: {bad!r}")
+        return run.run_id
     store_run = device_store().get_or_create(
         shuffle_id, run.run_id, run.spec.n_inputs,
         run.spec.npartitions_out,
@@ -312,9 +448,15 @@ async def device_shuffle_barrier(shuffle_id: str,
     if store_run is not None:  # None: duplicate rerun of a finished epoch
         # the collective is a compile+execute: keep the event loop free
         await asyncio.get_running_loop().run_in_executor(
-            None, store_run.exchange
+            None, store_run.exchange, max_n
         )
     return run.run_id
+
+
+def _multihost() -> bool:
+    from distributed_tpu.parallel.multihost import is_multihost
+
+    return is_multihost()
 
 
 async def device_shuffle_unpack(shuffle_id: str, partition_id: int,
@@ -366,15 +508,23 @@ async def p2p_shuffle_device(client: Any, inputs: list) -> list:
 
     n = len(inputs)
     shuffle_id = f"devshuffle-{uuid.uuid4().hex[:12]}"
-    worker_for = await _create_shuffle(client, shuffle_id, n, n)
+    worker_for, device_owned = await _create_shuffle(
+        client, shuffle_id, n, n, want_device_owned=True
+    )
 
     g = Graph()
     transfer_keys = []
+    annotations: dict = {}
     for i, fut in enumerate(inputs):
         k = f"{shuffle_id}-transfer-{i}"
         g.tasks[k] = TaskSpec(
             device_shuffle_transfer, (TaskRef(fut.key), shuffle_id, i)
         )
+        if device_owned:
+            # multi-host pod: partition i must REGISTER in the process
+            # owning global mesh device i — a transfer elsewhere would
+            # have to move the shard off its chips
+            annotations[k] = {"workers": [worker_for[i]]}
         transfer_keys.append(k)
     barrier_key = f"{shuffle_id}-barrier"
     g.tasks[barrier_key] = TaskSpec(
@@ -382,7 +532,6 @@ async def p2p_shuffle_device(client: Any, inputs: list) -> list:
         (shuffle_id, *[TaskRef(k) for k in transfer_keys]),
     )
     unpack_keys = []
-    annotations = {}
     for j in range(n):
         k = f"{shuffle_id}-unpack-{j}"
         g.tasks[k] = TaskSpec(

@@ -30,7 +30,7 @@ logger = logging.getLogger("distributed_tpu.shuffle")
 
 class ShuffleState:
     __slots__ = ("id", "run_id", "npartitions_out", "n_inputs", "worker_for",
-                 "participants", "attempts")
+                 "participants", "attempts", "device_owned", "wants_device")
 
     def __init__(self, id: str, run_id: int, npartitions_out: int,
                  n_inputs: int, worker_for: dict[int, str]):
@@ -46,6 +46,11 @@ class ShuffleState:
         # consecutive epoch restarts without a completed barrier: bounded
         # by shuffle.max-restarts, reset on barrier success
         self.attempts = 0
+        # worker_for came from pod device ownership (multihost plane);
+        # wants_device records that the graph builder asked for it, so
+        # epoch restarts recompute the same way
+        self.device_owned = False
+        self.wants_device = False
 
     @property
     def all_workers(self) -> set[str]:
@@ -57,6 +62,7 @@ class ShuffleState:
             "run_id": self.run_id,
             "npartitions_out": self.npartitions_out,
             "n_inputs": self.n_inputs,
+            "device_owned": self.device_owned,
             "worker_for": {str(k): v for k, v in self.worker_for.items()},
         }
 
@@ -88,15 +94,43 @@ class ShuffleSchedulerExtension:
 
     # ------------------------------------------------------------ helpers
 
-    def _calculate_worker_for(self, npartitions_out: int) -> dict[int, str]:
-        """Round-robin output partitions over sorted running workers
-        (reference _scheduler_plugin.py:182)."""
-        addrs = sorted(ws.address for ws in self.scheduler.state.running)
+    def _calculate_worker_for(self, npartitions_out: int,
+                              device: bool = False) -> tuple[dict[int, str], bool]:
+        """Map output partitions to workers.
+
+        Device-ownership mode: when workers joined a pod-wide jax
+        runtime (``--jax-coordinator``) they registered their global
+        mesh device indices; if those DISJOINTLY cover partitions
+        0..n-1, partition j is pinned to the process owning mesh device
+        j — the device data plane then never moves a shard off its
+        chips.  Otherwise: round-robin over sorted running workers
+        (reference _scheduler_plugin.py:182).  Returns
+        ``(worker_for, device_owned)``."""
+        state = self.scheduler.state
+        if device:
+            # ONLY device-plane shuffles ask for ownership mapping: a
+            # host-object shuffle must keep spreading over the whole
+            # cluster (ownership would concentrate every partition on
+            # the pod workers)
+            owners: dict[int, str] = {}
+            disjoint = True
+            for ws in state.running:
+                for d in ws.extra.get("jax_devices") or ():
+                    if d in owners:
+                        disjoint = False
+                    owners[int(d)] = ws.address
+            if (
+                disjoint
+                and owners
+                and all(j in owners for j in range(npartitions_out))
+            ):
+                return {j: owners[j] for j in range(npartitions_out)}, True
+        addrs = sorted(ws.address for ws in state.running)
         if not addrs:
-            addrs = sorted(self.scheduler.state.workers)
+            addrs = sorted(state.workers)
         if not addrs:
             raise RuntimeError("no workers available for shuffle")
-        return {j: addrs[j % len(addrs)] for j in range(npartitions_out)}
+        return {j: addrs[j % len(addrs)] for j in range(npartitions_out)}, False
 
     def _task_keys(self, st: ShuffleState) -> list[str]:
         """Insertion order matters: the transition engine drains
@@ -181,7 +215,9 @@ class ShuffleSchedulerExtension:
     def _restart(self, st: ShuffleState, reason: str) -> None:
         st.run_id += 1
         try:
-            st.worker_for = self._calculate_worker_for(st.npartitions_out)
+            st.worker_for, st.device_owned = self._calculate_worker_for(
+                st.npartitions_out, device=st.wants_device
+            )
         except RuntimeError:
             # no workers left (cluster draining): the shuffle cannot be
             # recomputed now; drop it so task bodies get unknown-shuffle
@@ -214,17 +250,22 @@ class ShuffleSchedulerExtension:
 
     async def handle_get_or_create(
         self, id: str = "", npartitions_out: int = 0, n_inputs: int = 0,
-        worker: str = "", **kwargs: Any,
+        worker: str = "", device: bool = False, **kwargs: Any,
     ) -> dict:
         st = self.active.get(id)
         if st is None:
-            st = self.active[id] = ShuffleState(
-                id, 1, npartitions_out, n_inputs,
-                self._calculate_worker_for(npartitions_out),
+            worker_for, device_owned = self._calculate_worker_for(
+                npartitions_out, device=device
             )
+            st = self.active[id] = ShuffleState(
+                id, 1, npartitions_out, n_inputs, worker_for,
+            )
+            st.device_owned = device_owned
+            st.wants_device = bool(device)
         if worker:
             st.participants.add(worker)
-        return {"status": "OK", "spec": st.to_msg()}
+        return {"status": "OK", "spec": st.to_msg(),
+                "device_owned": st.device_owned}
 
     async def handle_get_run(self, id: str = "", worker: str = "",
                              **kwargs: Any) -> dict:

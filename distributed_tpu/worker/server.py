@@ -82,9 +82,22 @@ class Worker(Server):
         lifetime: float | None = None,
         lifetime_stagger: float | None = None,
         nanny_addr: str | None = None,
+        jax_coordinator: str | None = None,
+        jax_process_id: int | None = None,
+        jax_num_processes: int | None = None,
+        jax_cpu_devices: int | None = None,
         **server_kwargs: Any,
     ):
         self.nanny_addr = nanny_addr
+        # multi-host device plane: when a coordinator is given, this
+        # process joins a pod-wide jax runtime at start (parallel/
+        # multihost.py) and reports its global mesh device indices to
+        # the scheduler so device-plane shuffles pin work to owners
+        self.jax_coordinator = jax_coordinator
+        self.jax_process_id = jax_process_id
+        self.jax_num_processes = jax_num_processes
+        self.jax_cpu_devices = jax_cpu_devices
+        self.jax_device_indices: list[int] | None = None
         self._http_port = http_port
         self.http_server = None
         self.monitor = None
@@ -237,6 +250,32 @@ class Worker(Server):
 
         native.prebuild_async()
         self.loop = asyncio.get_running_loop()
+        if self.jax_coordinator is not None:
+            # join the pod-wide jax runtime BEFORE any task can touch
+            # jax; blocking rendezvous runs off-loop
+            from distributed_tpu.parallel import multihost
+
+            def _join():
+                import jax
+
+                if (
+                    self.jax_cpu_devices
+                    and jax.config.jax_num_cpu_devices
+                    != int(self.jax_cpu_devices)
+                ):
+                    # no-op when the CLI already set it pre-backend
+                    jax.config.update(
+                        "jax_num_cpu_devices", int(self.jax_cpu_devices)
+                    )
+                multihost.maybe_initialize(
+                    self.jax_coordinator,
+                    process_id=self.jax_process_id,
+                    num_processes=self.jax_num_processes,
+                )
+                return multihost.local_device_indices()
+
+            self.jax_device_indices = await asyncio.get_running_loop(
+            ).run_in_executor(None, _join)
         addr = self._listen_addr
         if addr is None:
             addr = "tcp://127.0.0.1:0"
@@ -316,6 +355,7 @@ class Worker(Server):
                 "resources": self.state.total_resources,
                 "server_id": self.id,
                 "versions": get_versions(),
+                "jax_devices": self.jax_device_indices,
                 "reply": False,
             }
         )
