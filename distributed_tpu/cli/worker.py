@@ -102,6 +102,15 @@ async def run(args: argparse.Namespace) -> int:
     nworkers = (
         os.cpu_count() or 1 if args.nworkers == "auto" else int(args.nworkers)
     )
+    if args.jax_coordinator and nworkers != 1:
+        # one pod process id maps to ONE worker process: several workers
+        # sharing a process id either double-join the coordination
+        # service (--nanny) or report overlapping device ownership,
+        # breaking the device plane in confusing ways downstream
+        raise SystemExit(
+            "--jax-coordinator requires --nworkers 1 (one worker process "
+            "per pod process id); start one dtpu-worker per chip group"
+        )
     from distributed_tpu import config
 
     resources = json.loads(args.resources) if args.resources else None

@@ -104,6 +104,14 @@ def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryv
             data = _pickle.dumps(leaf.data, buffer_callback=buffers.append)
             head = {"serializer": "pickle", "num-buffers": len(buffers)}
             frames = [data] + list(buffers)
+        # COPY before annotating: a Serialized leaf hands back its OWN
+        # header dict, and one object can appear at many paths (e.g. a
+        # single erred exception blamed on 16 dependents in one report
+        # batch).  Mutating the shared dict made every sub-header carry
+        # the LAST path — 15 of the 16 placeholders had no frames and
+        # the receiving comm died on KeyError — and corrupted the
+        # stored Serialized for every later forward.
+        head = dict(head)
         # split big frames so no single read/write exceeds the shard size
         split_frames: list = []
         split_sizes: list[int] = []

@@ -231,23 +231,21 @@ async def merge_unpack(shuffle_id: str, partition_id: int,
 
 async def _create_shuffle(client: Any, shuffle_id: str,
                           npartitions_out: int, n_inputs: int,
-                          want_device_owned: bool = False):
-    """Register the shuffle with the scheduler extension; returns the
-    initial worker_for map (for unpack restrictions), or with
-    ``want_device_owned`` a ``(worker_for, device_owned)`` pair —
-    device_owned means worker_for pins partitions to the processes that
-    own the matching global mesh devices (multi-host device plane)."""
+                          device: bool = False):
+    """Register the shuffle with the scheduler extension.  Returns
+    ``(worker_for, device_owned)``: the partition->worker map (for
+    unpack restrictions) and whether it came from pod device ownership
+    (only requested — and only possible — when ``device`` is set; host
+    callers ignore the flag)."""
     resp = await client.scheduler.shuffle_get_or_create(
         id=shuffle_id, npartitions_out=npartitions_out, n_inputs=n_inputs,
-        device=want_device_owned,
+        device=device,
     )
     if resp.get("status") != "OK":
         raise RuntimeError(f"shuffle registration failed: {resp!r}")
     spec = resp["spec"]
     worker_for = {int(k): v for k, v in spec["worker_for"].items()}
-    if want_device_owned:
-        return worker_for, bool(resp.get("device_owned"))
-    return worker_for
+    return worker_for, bool(resp.get("device_owned"))
 
 
 def _build_pipeline(
@@ -294,7 +292,7 @@ async def p2p_shuffle(
     partitions; returns output futures."""
     npartitions_out = npartitions_out or len(inputs)
     shuffle_id = f"shuffle-{uuid.uuid4().hex[:12]}"
-    worker_for = await _create_shuffle(
+    worker_for, _ = await _create_shuffle(
         client, shuffle_id, npartitions_out, len(inputs)
     )
     g = Graph()
@@ -323,7 +321,7 @@ async def p2p_shuffle_arrays(
     vectorized numpy, ~100x the record-list path."""
     npartitions_out = npartitions_out or len(inputs)
     shuffle_id = f"shuffle-{uuid.uuid4().hex[:12]}"
-    worker_for = await _create_shuffle(
+    worker_for, _ = await _create_shuffle(
         client, shuffle_id, npartitions_out, len(inputs)
     )
     g = Graph()
@@ -371,7 +369,7 @@ async def p2p_rechunk(client: Any, chunks: list, chunk_sizes: list[int],
     assert sum(chunk_sizes) == sum(new_chunk_sizes)
     npartitions_out = len(new_chunk_sizes)
     shuffle_id = f"rechunk-{uuid.uuid4().hex[:12]}"
-    worker_for = await _create_shuffle(
+    worker_for, _ = await _create_shuffle(
         client, shuffle_id, npartitions_out, len(chunks)
     )
 
@@ -412,7 +410,7 @@ async def p2p_merge(
     npartitions_out = npartitions_out or max(len(left), len(right))
     shuffle_id = f"merge-{uuid.uuid4().hex[:12]}"
     n_inputs = len(left) + len(right)
-    worker_for = await _create_shuffle(
+    worker_for, _ = await _create_shuffle(
         client, shuffle_id, npartitions_out, n_inputs
     )
 

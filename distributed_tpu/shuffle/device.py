@@ -109,6 +109,15 @@ class DeviceRun:
                 d for d in range(n_dev)
                 if devices[d].process_index == jax.process_index()
             ]
+            if not local_ids and not self.parts:
+                # this process owns none of the shuffle's mesh devices
+                # AND holds no registrations (e.g. the barrier landed on
+                # a non-participant): nothing to contribute, and the
+                # SPMD program must not run here — the owners' exchanges
+                # carry the epoch.  Keep outputs None: a stray unpack
+                # here must take the Reschedule/restart path, not KeyError.
+                self.local_ids = []
+                return
             if set(self.parts) != set(local_ids):
                 raise RuntimeError(
                     f"device shuffle {self.id} run {self.run_id}: "
@@ -243,6 +252,12 @@ class DeviceShuffleStore:
                     del self.runs[key]
             return run
 
+    def was_served(self, id: str, run_id: int) -> bool:
+        """True when this epoch finished and was collected (every local
+        output unpacked into worker memory)."""
+        with self.lock:
+            return (id, run_id) in self._done_set
+
     def was_served_once(self, id: str, run_id: int, pid: int) -> bool:
         """True the FIRST time a finished-and-collected epoch sees a
         duplicate unpack of partition ``pid`` — the cheap reschedule
@@ -305,6 +320,36 @@ class DeviceShuffleStore:
                     self._done_set.add(key)
 
 
+async def _run_in_daemon_thread(fn, *args):
+    """Run a potentially-wedging call (a cross-host collective whose
+    rendezvous may never complete) on a THROWAWAY daemon thread.  The
+    shared default executor must not absorb the block: its threads also
+    serve spill/compile work, and one leaked thread per wedged epoch
+    starves the worker.  A daemon thread leaks nothing the process
+    cares about and dies with it."""
+    loop = asyncio.get_running_loop()
+    done = asyncio.Event()
+    box: list = []
+
+    def run():
+        try:
+            box.append((True, fn(*args)))
+        except BaseException as exc:  # noqa: BLE001 - relayed to awaiter
+            box.append((False, exc))
+        try:
+            loop.call_soon_threadsafe(done.set)
+        except RuntimeError:
+            pass
+
+    threading.Thread(target=run, daemon=True,
+                     name="dtpu-device-exchange").start()
+    await done.wait()
+    ok, val = box[0]
+    if not ok:
+        raise val
+    return val
+
+
 _store: DeviceShuffleStore | None = None
 
 
@@ -357,9 +402,7 @@ async def device_shuffle_exchange_handler(worker: Any, id: str = "",
     )
     if store_run is None:
         return {"status": "done"}
-    await asyncio.get_running_loop().run_in_executor(
-        None, store_run.exchange, max_n
-    )
+    await _run_in_daemon_thread(store_run.exchange, max_n)
     return {"status": "OK"}
 
 
@@ -373,6 +416,10 @@ async def device_shuffle_precheck_handler(worker: Any, id: str = "",
     run = await worker.shuffle.get_or_create_remote(id)
     if run.run_id != run_id:
         return {"status": "stale", "run_id": run.run_id}
+    if device_store().was_served(id, run_id):
+        # duplicate rerun of a FINISHED epoch (steal race): outputs are
+        # already in worker memory — the barrier must no-op, not restart
+        return {"status": "done"}
     store_run = device_store().runs.get((id, run_id))
     if store_run is None:
         return {"status": "no-parts"}
@@ -391,18 +438,20 @@ async def device_shuffle_barrier(shuffle_id: str,
     await run.barrier()
     max_n = max((int(n) for _, n in transfer_results), default=1)
     participants = set(run.spec.worker_for.values())
-    if len(participants) > 1 and _multihost():
-        if not run.spec.device_owned:
-            # overlapping/non-covering device ownership (e.g. several
-            # worker processes sharing one jax runtime): registrations
-            # are scattered across processes and no SPMD exchange can
-            # assemble them.  Fail loudly with the remedy.
-            raise RuntimeError(
-                "device shuffle on a multi-process pod requires "
-                "device-owned placement: start ONE worker process per "
-                "chip group with --jax-coordinator/--jax-process-id so "
-                "ownership is disjoint (got round-robin worker_for)"
-            )
+    if _multihost() and not run.spec.device_owned and len(participants) > 1:
+        # overlapping/non-covering device ownership (e.g. several
+        # worker processes sharing one jax runtime): registrations
+        # are scattered across processes and no SPMD exchange can
+        # assemble them.  Fail loudly with the remedy.
+        raise RuntimeError(
+            "device shuffle on a multi-process pod requires "
+            "device-owned placement: start ONE worker process per "
+            "chip group with --jax-coordinator/--jax-process-id so "
+            "ownership is disjoint (got round-robin worker_for)"
+        )
+    if _multihost() and run.spec.device_owned:
+        # fan out — even to a single owner: this barrier task may be
+        # running on a NON-owner process with no shards
         timeout = 120.0
 
         async def call(addr: str, op: str):
@@ -426,6 +475,10 @@ async def device_shuffle_barrier(shuffle_id: str,
         pre = await asyncio.wait_for(
             asyncio.gather(*(call(a, "precheck") for a in addrs)), timeout
         )
+        if any(r.get("status") == "done" for r in pre):
+            # the epoch already completed globally (duplicate barrier
+            # rerun): outputs live in worker memory; nothing to exchange
+            return run.run_id
         bad = [
             (a, r) for a, r in zip(addrs, pre) if r.get("status") != "OK"
         ]
@@ -447,9 +500,7 @@ async def device_shuffle_barrier(shuffle_id: str,
     )
     if store_run is not None:  # None: duplicate rerun of a finished epoch
         # the collective is a compile+execute: keep the event loop free
-        await asyncio.get_running_loop().run_in_executor(
-            None, store_run.exchange, max_n
-        )
+        await _run_in_daemon_thread(store_run.exchange, max_n)
     return run.run_id
 
 
@@ -509,7 +560,7 @@ async def p2p_shuffle_device(client: Any, inputs: list) -> list:
     n = len(inputs)
     shuffle_id = f"devshuffle-{uuid.uuid4().hex[:12]}"
     worker_for, device_owned = await _create_shuffle(
-        client, shuffle_id, n, n, want_device_owned=True
+        client, shuffle_id, n, n, device=True
     )
 
     g = Graph()

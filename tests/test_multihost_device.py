@@ -168,5 +168,6 @@ async def test_two_process_pod_device_shuffle():
                     p.kill()
             for lf in logs:
                 lf.close()
-                os.unlink(lf.name)
+                if not os.environ.get("DTPU_KEEP_POD_LOGS"):
+                    os.unlink(lf.name)
             await s.close()

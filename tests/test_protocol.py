@@ -171,3 +171,30 @@ def test_arrow_empty_batch_and_frame_contract():
     header, frames = serialize(pa.table({"k": [1, 2, 3]}))
     assert all(isinstance(f, (bytes, bytearray, memoryview)) for f in frames)
     assert payload_nbytes(Serialized(header, frames)) > 0
+
+
+def test_shared_serialized_leaf_many_paths():
+    """One Serialized object at MANY message paths (a single erred
+    exception blamed on every dependent in one report batch): each
+    placeholder must get its own sub-header/frames.  dumps used to
+    annotate the leaf's own header dict in place, so all sub-headers
+    aliased the last path and 15 of 16 placeholders lost their frames
+    (found by the 2-process pod test: the client report stream died on
+    KeyError and every future errored with 'lost connection')."""
+    from distributed_tpu.protocol.core import dumps, loads
+    from distributed_tpu.protocol.serialize import Serialize, serialize, Serialized
+
+    exc = ValueError("boom")
+    header, frames = serialize(Serialize(exc))
+    shared = Serialized(header, frames)
+    msgs = [
+        {"op": "task-erred", "key": f"k{i}", "exception": shared}
+        for i in range(16)
+    ]
+    out = loads(dumps(msgs))
+    assert len(out) == 16
+    for m in out:
+        assert isinstance(m["exception"], ValueError)
+        assert str(m["exception"]) == "boom"
+    # the shared header must NOT have been polluted with path metadata
+    assert "path" not in header and "frame-start" not in header
