@@ -45,6 +45,13 @@ defaults: dict[str, Any] = {
         "no-workers-timeout": None,
         "work-stealing": True,
         "work-stealing-interval": "100ms",
+        # skip the steal confirm round trip for tasks deep in a big
+        # victim backlog (>=4x nthreads): the victim gets free-keys and
+        # the thief is dispatched immediately.  A wrong guess (task
+        # already executing) wastes one execution but is always correct
+        # (stale completions are fenced by processing_on).  Off by
+        # default: the confirm protocol is the reference-proven path.
+        "work-stealing-speculative": False,
         "worker-saturation": 1.1,       # queuing threshold (yaml:24)
         "worker-ttl": "5 minutes",
         "unknown-task-duration": "500ms",

@@ -8,24 +8,28 @@ batches one balance cycle into K Jacobi rounds of a single jitted
 program over SoA arrays:
 
 per round
-  1. every victim worker nominates its best still-unstolen stealable
-     task (lowest (level, arrival-rank), exactly the python scan order);
-  2. victims are ranked by per-thread load descending, idle thieves
-     ascending, and rank r victim is paired with rank r thief — a
-     parallel matching instead of the python's one-at-a-time argmin;
+  1. unstolen stealable tasks are ordered busiest-victim-first, then by
+     (level, arrival-rank) — the python scan order within a victim;
+  2. ONE TASK PER IDLE THIEF: rank r task goes to rank r least-loaded
+     thief.  Per-victim nomination (the old scheme) drained a single
+     overloaded worker one task per round — a 320-task pile on one
+     victim took 40 rounds; per-thief pairing lets it feed the whole
+     fleet in one round;
   3. each pair applies the reference steal criterion
      ``occ_thief/nthreads + cost + compute <= occ_victim/nthreads -
-     compute/2`` (reference stealing.py:462-465); accepted moves update
-     occupancy, mark tasks stolen, and refresh the idle set
+     compute/2`` (reference stealing.py:462-465).  When one victim
+     donates several tasks in a round, each criterion conservatively
+     assumes every OTHER same-victim candidate was already applied;
+     accepted moves update occupancy (scatter-add over repeated
+     victims), mark tasks stolen, and refresh the idle set
      (``occ/nthreads > LATENCY`` retires a thief, reference
      stealing.py:447).
 
-Because a round's accepted moves touch pairwise-distinct victims and
-thieves, replaying them sequentially in any order reproduces the same
-occupancy trajectory the kernel used — every emitted move satisfies the
-python criterion at its application point (tested in
-tests/test_ops_stealing_amm.py by sequential re-validation against the
-python oracle).
+Thieves are pairwise-distinct within a round and same-victim criteria
+are evaluated against the full other-candidate load, so replaying the
+accepted moves sequentially in ANY order satisfies the python criterion
+at each application point (tested in tests/test_ops_stealing_amm.py by
+sequential re-validation against the python oracle).
 
 The decisions feed the existing async confirm protocol
 (``move_task_request``) unchanged: the device only batches the
@@ -90,36 +94,50 @@ def _steal_rounds(
 
     def round_body(_, carry):
         taken, thief_of, occ, idle = carry
-        # 1. best task per victim (lowest key among unstolen)
+        # 1. order unstolen tasks: busiest victim first, then steal key.
+        #    ONE TASK PER THIEF per round (not per victim): a single
+        #    overloaded worker must be able to donate to every idle
+        #    thief at once — per-victim nomination drained config 3's
+        #    320-task pile 8 tasks per cycle (balance_efficiency 0.5).
         key = jnp.where(taken[:T], IMAX, task_key)
-        best_key = jax.ops.segment_min(key, task_victim, num_segments=W)
-        is_best = (key == best_key[task_victim]) & (key != IMAX)
-        best_idx = jax.ops.segment_min(
-            jnp.where(is_best, idx, T), task_victim, num_segments=W
-        )
-        has_task = best_idx < T
-
-        # 2. rank-matched pairing: busiest victims with least-loaded thieves
         vload = occ / threads
-        vic_order = jnp.argsort(
-            jnp.where(has_task & running, -vload, jnp.inf)
+        usable = (key != IMAX) & running[task_victim]
+        order = jnp.lexsort(
+            (key, jnp.where(usable, -vload[task_victim], jnp.inf))
         )
         thief_order = jnp.argsort(jnp.where(idle & running, vload, jnp.inf))
-        n_vic = (has_task & running).sum()
         n_th = (idle & running).sum()
-        v = vic_order[r]
+        n_usable = usable.sum()
+        t = order[jnp.minimum(r, T - 1)]
+        # r < n_usable: without it, fleets with more idle thieves than
+        # stealable tasks would clamp several slots onto the LAST task
+        # and double-steal it (corrupting occupancy for later rounds)
+        cand_ok = (r < n_th) & (r < n_usable) & usable[t]
         th = thief_order[r]
-        pair_ok = (r < jnp.minimum(n_vic, n_th)) & (v != th)
 
-        # 3. the reference criterion per pair
-        t = jnp.where(pair_ok, best_idx[v], T)
-        tc = jnp.where(t < T, task_cost[jnp.minimum(t, T - 1)], 0.0)
-        cp = jnp.where(t < T, task_compute[jnp.minimum(t, T - 1)], 0.0)
-        crit = vload[th] + tc + cp <= vload[v] - cp / 2
-        acc = pair_ok & crit
+        # 2. same-victim load adjustment: when one victim donates
+        #    several tasks this round, each criterion assumes EVERY
+        #    other same-victim candidate was already applied — a
+        #    superset of the accepted ones, so the accepted moves
+        #    satisfy the sequential python criterion replayed in ANY
+        #    order (over-conservative rejections cost a round, later
+        #    rounds pick them back up)
+        vic = task_victim[t]
+        tc = jnp.where(cand_ok, task_cost[t], 0.0)
+        cp = jnp.where(cand_ok, task_compute[t], 0.0)
+        same = (vic[None, :] == vic[:, None]) & cand_ok[None, :] & cand_ok[:, None]
+        others_cp = (same * cp[None, :]).sum(axis=1) - cp
 
-        # apply accepted moves (distinct victims & thieves within a round)
-        occ = occ.at[jnp.where(acc, v, W)].add(-cp, mode="drop")
+        # 3. the reference criterion per (task, thief) pair
+        crit = (
+            vload[th] + tc + cp
+            <= vload[vic] - others_cp / threads[vic] - cp / 2
+        )
+        acc = cand_ok & crit & (vic != th)
+
+        # apply accepted moves (thieves distinct by construction;
+        # repeated victims accumulate via scatter-add)
+        occ = occ.at[jnp.where(acc, vic, W)].add(-cp, mode="drop")
         occ = occ.at[jnp.where(acc, th, W)].add(cp + tc, mode="drop")
         taken = taken.at[jnp.where(acc, t, T)].set(True)
         thief_of = thief_of.at[jnp.where(acc, t, T)].set(

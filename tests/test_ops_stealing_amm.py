@@ -95,9 +95,14 @@ def test_steal_prefers_low_levels():
         running=np.ones(W, bool),
     )
     thief_of = plan_steals(batch, rounds=1)
-    # exactly one steal in one round, and it must be the level-0 task
-    assert (thief_of >= 0).sum() == 1
-    assert thief_of[5] >= 0
+    # one round, one task per idle THIEF (a single overloaded victim can
+    # feed the whole fleet at once); steal order follows (level, rank)
+    stolen = set(np.flatnonzero(thief_of >= 0).tolist())
+    assert 1 <= len(stolen) <= 3  # 3 idle thieves
+    # the stolen tasks must be exactly the lowest-(level, rank) ones:
+    # levels [9,1,5,1,14,0,7,3] -> 0 (idx 5), then 1 (idx 1), 1 (idx 3)
+    expected_order = [5, 1, 3]
+    assert stolen == set(expected_order[: len(stolen)]), (stolen, thief_of)
 
 
 def test_no_steals_when_balanced():

@@ -137,3 +137,37 @@ async def test_amm_respects_processing_waiters():
             await asyncio.sleep(0.1)
             assert len(ts.who_has) == 1
             assert await fut.result() == 2
+
+
+@gen_test(timeout=120)
+async def test_speculative_steal_correctness():
+    """Speculative handoff (no confirm round trip): a deep pile on one
+    worker spreads, results stay correct, and any double-executed task
+    is fenced (the thief's run is authoritative)."""
+    from distributed_tpu import config
+
+    def slow(x, delay=0.05):
+        import time
+
+        time.sleep(delay)
+        return x + 1
+
+    with config.set({"scheduler.work-stealing-speculative": True,
+                     "scheduler.work-stealing-interval": "50ms",
+                     "scheduler.jax.enabled": False}):
+        async with LocalCluster(
+            n_workers=3,
+            scheduler_kwargs={"validate": True},
+            worker_kwargs={"validate": True},
+        ) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                a = cluster.workers[0].address
+                futs = c.map(slow, range(24), workers=[a],
+                             allow_other_workers=True, pure=False)
+                assert await asyncio.wait_for(c.gather(futs), 60) == list(
+                    range(1, 25)
+                )
+                steal = cluster.scheduler.extensions["stealing"]
+                assert any(e[0] == "speculative" for e in steal.log), (
+                    "speculative path never engaged"
+                )
