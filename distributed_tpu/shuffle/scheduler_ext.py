@@ -300,11 +300,36 @@ class ShuffleSchedulerExtension:
                 raise RuntimeError(
                     f"inputs_done rejected by {addr}: {resp!r}"
                 )
+            return addr, resp.get("sent") or {}
 
         results = await asyncio.gather(
             *(one(a) for a in sorted(st.all_workers)), return_exceptions=True
         )
         failures = [r for r in results if isinstance(r, BaseException)]
+        if not failures:
+            # round 2: every RECEIVER confirms it processed the pushes
+            # the senders reported — the scheduler aggregates the counts
+            # so confirmation costs ONE rpc per worker instead of a
+            # flush round trip per (sender, receiver) pair
+            expected: dict[str, dict[str, int]] = {}
+            for addr, sent in results:
+                for peer, n in sent.items():
+                    expected.setdefault(peer, {})[addr] = int(n)
+
+            async def confirm(addr: str):
+                resp = await self.scheduler.rpc(addr).shuffle_wait_pushes(
+                    id=id, run_id=run_id, expected=expected.get(addr) or {}
+                )
+                if resp.get("status") != "OK":
+                    raise RuntimeError(
+                        f"push confirmation failed on {addr}: {resp!r}"
+                    )
+
+            res2 = await asyncio.gather(
+                *(confirm(a) for a in sorted(expected)),
+                return_exceptions=True,
+            )
+            failures = [r for r in res2 if isinstance(r, BaseException)]
         if failures:
             # a participant died or went stale mid-barrier: restart the
             # epoch rather than serve partial outputs
