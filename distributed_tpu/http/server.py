@@ -64,6 +64,18 @@ class HTTPServer:
                     break
             handler = self.routes.get(path)
             if handler is None:
+                # parameterized routes: "/prefix/{rest}" entries receive
+                # the remainder of the path as their single argument
+                # (the per-worker proxy role, reference http/proxy.py:147)
+                for route, fn in self.routes.items():
+                    if not route.endswith("/{rest}"):
+                        continue
+                    prefix = route[: -len("/{rest}")]
+                    if path == prefix or path.startswith(prefix + "/"):
+                        rest = path[len(prefix) + 1:]
+                        handler = (lambda fn=fn, rest=rest: fn(rest))
+                        break
+            if handler is None:
                 body = b"not found"
                 status, ctype = "404 Not Found", "text/plain"
             else:
@@ -71,7 +83,16 @@ class HTTPServer:
                     result = handler()
                     if asyncio.iscoroutine(result):
                         result = await result
-                    if isinstance(result, tuple) and len(result) == 2:
+                    status = "200 OK"
+                    if isinstance(result, tuple) and len(result) == 3:
+                        # (body, content_type, status) — error pages
+                        # must carry real HTTP codes, not 200-JSON
+                        body, ctype, status = result
+                        if isinstance(body, (dict, list)):
+                            body = json.dumps(body, default=str)
+                        if isinstance(body, str):
+                            body = body.encode()
+                    elif isinstance(result, tuple) and len(result) == 2:
                         # (body, content_type) for non-default types
                         body, ctype = result
                         if isinstance(body, str):
@@ -85,7 +106,6 @@ class HTTPServer:
                     else:
                         body = str(result).encode()
                         ctype = "text/plain"
-                    status = "200 OK"
                 except Exception as e:
                     logger.exception("http handler %s failed", path)
                     body = f"error: {e}".encode()

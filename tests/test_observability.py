@@ -291,3 +291,85 @@ async def test_dashboard_profile_and_graph_routes():
                 for needle in ("drawGraph", "drawFlame",
                                "/api/v1/graph", "/api/v1/profile"):
                     assert needle in html, needle
+
+
+@gen_test(timeout=120)
+async def test_worker_proxy_pages_with_deaths():
+    """Per-worker pages THROUGH the scheduler (reference http/proxy.py
+    role): health / metrics / profile / info render for live workers
+    and stay serviceable while workers die mid-run."""
+    import functools
+    import json as _json
+    import urllib.request
+
+    async def fetch(url, expect_status=200):
+        loop = asyncio.get_running_loop()
+
+        def get(u):
+            import urllib.error
+
+            try:
+                r = urllib.request.urlopen(u, timeout=10)
+                return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        status, body = await loop.run_in_executor(
+            None, functools.partial(get, url)
+        )
+        assert status == expect_status, (url, status, body[:200])
+        return body
+
+    def slow(x):
+        import time as _t
+
+        _t.sleep(0.05)
+        return x + 1
+
+    async with LocalCluster(n_workers=4, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            port = cluster.scheduler.http_server.port
+            base = f"http://127.0.0.1:{port}"
+            futs = c.map(slow, range(40), pure=False)
+
+            idx = _json.loads(await fetch(f"{base}/workers/"))
+            assert len(idx) == 4
+            name = idx[0]["name"]
+            health = _json.loads(await fetch(f"{base}/workers/{name}/health"))
+            assert health["ok"] is True
+            metrics = _json.loads(
+                await fetch(f"{base}/workers/{name}/metrics")
+            )
+            assert metrics["worker"] == idx[0]["address"]
+            prof = _json.loads(await fetch(f"{base}/workers/{name}/profile"))
+            assert isinstance(prof, dict)
+            info = _json.loads(await fetch(f"{base}/workers/{name}/info"))
+            assert info["nthreads"] == 1
+
+            # two workers die mid-run: the proxy keeps answering — the
+            # index shrinks, a dead name 404s gracefully, survivors serve
+            victims = [w for w in cluster.workers[:2]]
+            dead_names = [str(w.name) for w in victims]
+            for w in victims:
+                await w.close(report=False)
+            cluster.workers = cluster.workers[2:]
+            deadline = asyncio.get_running_loop().time() + 30
+            while len(cluster.scheduler.state.workers) > 2:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            idx2 = _json.loads(await fetch(f"{base}/workers"))
+            assert len(idx2) == 2
+            gone = _json.loads(
+                await fetch(f"{base}/workers/{dead_names[0]}/health",
+                            expect_status=404)
+            )
+            assert "error" in gone
+            survivor = idx2[0]["name"]
+            health2 = _json.loads(
+                await fetch(f"{base}/workers/{survivor}/health")
+            )
+            assert health2["ok"] is True
+            # the run itself survives the deaths
+            assert await asyncio.wait_for(c.gather(futs), 60) == list(
+                range(1, 41)
+            )
