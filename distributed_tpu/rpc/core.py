@@ -591,10 +591,19 @@ class PooledRPCCall(RPCCall):
         comm = await self.pool.connect(self.address)
         prev_name, comm.name = comm.name, "rpc"
         try:
-            return await send_recv(comm, **kwargs)
-        finally:
-            self.pool.reuse(self.address, comm)
+            result = await send_recv(comm, **kwargs)
+        except BaseException:
+            # cancellation or failure mid-request: the reply (if it ever
+            # comes) is still in flight — returning this comm to the
+            # pool would hand the NEXT caller a stale response and
+            # desynchronize every later RPC on it.  Abort instead.
+            comm.abort()
+            self.pool.reuse(self.address, comm)  # pool discards closed comms
             comm.name = prev_name
+            raise
+        self.pool.reuse(self.address, comm)
+        comm.name = prev_name
+        return result
 
     def __repr__(self) -> str:
         return f"<pooled rpc to {self.address!r}>"

@@ -1384,6 +1384,24 @@ class Scheduler(Server):
             f"<td>{sp.nbytes}</td></tr>"
             for sp in spans
         )
+        # per-activity fine metrics (reference metrics.py:159 ContextMeter
+        # samples aggregated over heartbeats): seconds/bytes per
+        # (context, activity-label) — execute, gather-dep network vs
+        # deserialize vs other, spill serialize/disk-write/disk-read
+        activities: dict[tuple[str, str, str], float] = {}
+        for key, v in self.spans.cumulative_worker_metrics.items():
+            # key = (context, span_id, prefix, label, unit)
+            try:
+                context, _sid, _pre, label, unit = key
+            except Exception:
+                continue
+            k = (str(context), str(label), str(unit))
+            activities[k] = activities.get(k, 0.0) + float(v)
+        act_rows = "".join(
+            f"<tr><td>{_html.escape(ctx)}</td><td>{_html.escape(label)}</td>"
+            f"<td>{val:.3f}</td><td>{_html.escape(unit)}</td></tr>"
+            for (ctx, label, unit), val in sorted(activities.items())
+        )
         return f"""<!doctype html><html><head><meta charset="utf-8">
 <title>distributed_tpu performance report</title></head><body>
 <h1>distributed_tpu performance report</h1>
@@ -1392,6 +1410,9 @@ class Scheduler(Server):
 <h2>Workers</h2>
 <table border="1"><tr><th>address</th><th>threads</th><th>stored</th>
 <th>bytes</th><th>occupancy</th></tr>{rows}</table>
+<h2>Activities (fine metrics)</h2>
+<table border="1"><tr><th>context</th><th>activity</th><th>total</th>
+<th>unit</th></tr>{act_rows}</table>
 <h2>Spans</h2>
 <table border="1"><tr><th>span</th><th>tasks</th><th>compute s</th>
 <th>bytes</th></tr>{span_rows}</table>

@@ -89,3 +89,42 @@ class FineMetrics:
     def rows(delta: dict[tuple, float]) -> list[list[Any]]:
         """msgpack-friendly encoding of a delta."""
         return [[*k, v] for k, v in delta.items()]
+
+
+class DelayedMetricsLedger:
+    """Metrics collector for one ASYNC instruction (reference
+    metrics.py:336 DelayedMetricsLedger).
+
+    A gather-dep or execute spans many event-loop iterations; samples
+    produced while it runs (network reads, deserialize, disk writes)
+    must be attributed to THAT instruction even though other coroutines
+    interleave.  ``activity()`` installs a context-local callback (so
+    only awaits on this coroutine's context record here), and
+    ``finalize`` files everything plus the un-metered remainder as
+    ``other`` — the time the instruction spent scheduled but not inside
+    any bracket (loop contention, executor queueing).
+    """
+
+    def __init__(self, sink: Callable[[str, float, str], None]):
+        self._sink = sink
+        self.samples: list[tuple[str, float, str]] = []
+        self.start = time()
+
+    def record(self, label: str, value: float, unit: str) -> None:
+        self.samples.append((label, value, unit))
+
+    @contextlib.contextmanager
+    def activity(self) -> Iterator[None]:
+        with context_meter.add_callback(self.record):
+            yield
+
+    def finalize(self, other_label: str = "other") -> None:
+        elapsed = time() - self.start
+        metered = sum(
+            v for _, v, unit in self.samples if unit == "seconds"
+        )
+        for label, value, unit in self.samples:
+            self._sink(label, value, unit)
+        remainder = elapsed - metered
+        if remainder > 0:
+            self._sink(other_label, remainder, "seconds")

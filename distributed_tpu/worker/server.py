@@ -126,6 +126,9 @@ class Worker(Server):
             data = SpillBuffer(
                 self._work_dir.path,
                 target=int(mem_cfg["target"] * memory_limit),
+                metrics_cb=lambda label, value, unit: self._fine_metric(
+                    "spill", None, "", label, unit, value
+                ),
             )
         self.state = WorkerState(
             nthreads=self.nthreads,
@@ -911,36 +914,58 @@ class Worker(Server):
     async def _gather_dep(
         self, worker: str, to_gather: tuple, total_nbytes: int, stimulus_id: str
     ) -> StateMachineEvent:
-        """Fetch a batch of keys from one peer (reference worker.py:2030)."""
-        t0 = time()
+        """Fetch a batch of keys from one peer (reference worker.py:2030).
+
+        Metered through a DelayedMetricsLedger (reference metrics.py:336):
+        the instruction spans many loop iterations, and its network /
+        deserialize split plus the un-metered remainder ("other": loop
+        contention, pool queueing) must land on THIS activity."""
+        from distributed_tpu.worker.metrics import (
+            DelayedMetricsLedger,
+            context_meter,
+        )
+
+        ledger = DelayedMetricsLedger(
+            lambda label, value, unit: self._fine_metric(
+                "gather-dep", None, "", label, unit, value
+            )
+        )
         try:
-            resp = await self.rpc(worker).get_data(
-                keys=list(to_gather), who=self.address
-            )
-        except (CommClosedError, OSError, asyncio.TimeoutError):
-            self.state._gather_finished(worker)
-            return GatherDepNetworkFailureEvent(
-                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather)
-            )
-        except Exception as e:
-            self.state._gather_finished(worker)
-            return GatherDepFailureEvent(
-                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather),
-                exception=e, traceback=None,
-            )
-        self.state._gather_finished(worker)
-        if resp.get("status") == "busy":
-            return GatherDepBusyEvent(
-                stimulus_id=stimulus_id, worker=worker, keys=tuple(to_gather)
-            )
-        data = {k: unwrap(v) for k, v in resp.get("data", {}).items()}
-        nbytes = sum(sizeof(v) for v in data.values())
-        self._fine_metric(
-            "gather-dep", None, "", "transfer", "seconds", time() - t0
-        )
-        self._fine_metric(
-            "gather-dep", None, "", "transfer", "bytes", float(nbytes)
-        )
+            with ledger.activity():
+                try:
+                    with context_meter.meter("network"):
+                        resp = await self.rpc(worker).get_data(
+                            keys=list(to_gather), who=self.address
+                        )
+                except (CommClosedError, OSError, asyncio.TimeoutError):
+                    self.state._gather_finished(worker)
+                    return GatherDepNetworkFailureEvent(
+                        stimulus_id=stimulus_id, worker=worker,
+                        keys=tuple(to_gather),
+                    )
+                except Exception as e:
+                    self.state._gather_finished(worker)
+                    return GatherDepFailureEvent(
+                        stimulus_id=stimulus_id, worker=worker,
+                        keys=tuple(to_gather), exception=e, traceback=None,
+                    )
+                self.state._gather_finished(worker)
+                if resp.get("status") == "busy":
+                    return GatherDepBusyEvent(
+                        stimulus_id=stimulus_id, worker=worker,
+                        keys=tuple(to_gather),
+                    )
+                with context_meter.meter("deserialize"):
+                    data = {
+                        k: unwrap(v) for k, v in resp.get("data", {}).items()
+                    }
+                    nbytes = sum(sizeof(v) for v in data.values())
+            ledger.record("transfer", float(nbytes), "bytes")
+        finally:
+            # failed/busy fetches must be attributed too — a cluster
+            # drowning in transfer retries would otherwise report zero
+            # gather-dep network seconds
+            ledger.finalize()
         return GatherDepSuccessEvent(
             stimulus_id=stimulus_id,
             worker=worker,

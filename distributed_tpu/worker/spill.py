@@ -26,7 +26,13 @@ logger = logging.getLogger("distributed_tpu.spill")
 class SpillBuffer(MutableMapping):
     """{key: value} with a byte-bounded fast layer (reference spill.py:69)."""
 
-    def __init__(self, spill_directory: str | None = None, target: int = 0):
+    def __init__(self, spill_directory: str | None = None, target: int = 0,
+                 metrics_cb=None):
+        # metrics_cb(label, value, unit): fine-metrics sink — the worker
+        # wires this so serialize/disk-write/disk-read seconds and bytes
+        # show up per activity in spans / performance_report (reference
+        # metrics.py captures these inside its spill brackets)
+        self.metrics_cb = metrics_cb
         self.spill_directory = spill_directory or tempfile.mkdtemp(
             prefix="dtpu-spill-"
         )
@@ -106,6 +112,9 @@ class SpillBuffer(MutableMapping):
             return -1
         key = next(iter(self.fast))
         value = self.fast[key]
+        from distributed_tpu.utils.misc import time as _now
+
+        t0 = _now()
         try:
             payload = pickle.dumps(value, protocol=5)
         except Exception:
@@ -115,8 +124,13 @@ class SpillBuffer(MutableMapping):
             self.fast[key] = v
             logger.warning("cannot spill unpicklable key %r", key)
             return -1
+        t1 = _now()
         with open(self._path(key), "wb") as f:
             f.write(payload)
+        if self.metrics_cb is not None:
+            self.metrics_cb("serialize", t1 - t0, "seconds")
+            self.metrics_cb("disk-write", _now() - t1, "seconds")
+            self.metrics_cb("disk-write", float(len(payload)), "bytes")
         del self.fast[key]
         size = self.fast_sizes.pop(key)
         self.fast_bytes -= size
@@ -126,8 +140,17 @@ class SpillBuffer(MutableMapping):
         return size
 
     def _unspill(self, key: str) -> Any:
+        from distributed_tpu.utils.misc import time as _now
+
+        t0 = _now()
         with open(self._path(key), "rb") as f:
-            value = pickle.loads(f.read())
+            payload = f.read()
+        t1 = _now()
+        value = pickle.loads(payload)
+        if self.metrics_cb is not None:
+            self.metrics_cb("disk-read", t1 - t0, "seconds")
+            self.metrics_cb("disk-read", float(len(payload)), "bytes")
+            self.metrics_cb("deserialize", _now() - t1, "seconds")
         self.slow_bytes -= self.slow.pop(key)
         try:
             os.unlink(self._path(key))

@@ -373,3 +373,51 @@ async def test_worker_proxy_pages_with_deaths():
             assert await asyncio.wait_for(c.gather(futs), 60) == list(
                 range(1, 41)
             )
+
+
+@gen_test(timeout=120)
+async def test_performance_report_activity_seconds_spill_workload():
+    """The done-criterion for fine metrics (reference metrics.py:159,336):
+    a spill-heavy workload's performance report carries per-activity
+    seconds — spill serialize/disk-write/disk-read plus the gather-dep
+    network/deserialize/other split from the DelayedMetricsLedger."""
+    import numpy as np
+
+    def chunk(i):
+        return np.full((512, 256), float(i))  # ~1 MB
+
+    def combine(a, b):
+        return float(a.sum() + b.sum())
+
+    async with LocalCluster(
+        n_workers=2,
+        threads_per_worker=1,
+        worker_kwargs={"memory_limit": 4_000_000,  # ~4 chunks -> spills
+                       "heartbeat_interval": 0.1},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            chunks = c.map(chunk, range(10), pure=False)
+            # cross-worker combines force gather-dep traffic
+            outs = [
+                c.submit(combine, a, b, pure=False)
+                for a, b in zip(chunks[:-1], chunks[1:])
+            ]
+            await asyncio.wait_for(c.gather(outs), 60)
+            # let a couple of heartbeats ship the fine-metric deltas
+            deadline = asyncio.get_running_loop().time() + 15
+            spans = cluster.scheduler.spans
+            def have(context, label):
+                return any(
+                    k[0] == context and k[3] == label and v > 0
+                    for k, v in spans.cumulative_worker_metrics.items()
+                )
+            while not (have("spill", "disk-write")
+                       and have("gather-dep", "network")):
+                assert asyncio.get_running_loop().time() < deadline, (
+                    dict(spans.cumulative_worker_metrics)
+                )
+                await asyncio.sleep(0.1)
+            html = await cluster.scheduler.performance_report_html()
+            assert "Activities (fine metrics)" in html
+            for needle in ("disk-write", "network", "deserialize"):
+                assert needle in html, needle

@@ -140,7 +140,7 @@ async def test_fine_metrics_per_span_activity():
                 for k, v in fine.items()
             ), fine
             assert any(
-                k.startswith("gather-dep|") and "transfer|seconds" in k
+                k.startswith("gather-dep|") and "network|seconds" in k
                 for k in fine
             ), fine
             assert any(
