@@ -1,0 +1,113 @@
+"""Cluster-dump explorer (reference cluster_dump.py:111 DumpArtefact).
+
+``Client.dump_cluster_state(filename)`` writes the scheduler's full
+state as JSON; this module loads such a dump back and answers the
+questions a post-mortem actually asks — which tasks were stuck where,
+what a worker held, which story led to a state — without a live
+cluster.
+
+    from distributed_tpu.diagnostics.cluster_dump import DumpArtefact
+
+    d = DumpArtefact.from_file("dump.json")
+    d.tasks_in_state("processing")
+    d.worker_of("my-key")
+    d.story("my-key")
+    d.workers_summary()
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+
+class DumpArtefact:
+    """Queryable view over one ``dump_cluster_state`` snapshot."""
+
+    def __init__(self, state: dict):
+        self.state = state or {}
+        sched = self.state.get("scheduler") or {}
+        self.tasks: dict[str, dict] = dict(sched.get("tasks") or {})
+        self.workers: dict[str, dict] = dict(sched.get("workers") or {})
+        self.transition_log: list = list(sched.get("transition_log") or [])
+        self.events: dict = dict(sched.get("events") or {})
+
+    @classmethod
+    def from_file(cls, path: str) -> "DumpArtefact":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    # ------------------------------------------------------------- queries
+
+    def tasks_in_state(self, *states: str) -> dict[str, dict]:
+        """Tasks currently in any of the given states ('' = all)."""
+        wanted = set(states)
+        return {
+            k: t for k, t in self.tasks.items()
+            if not wanted or t.get("state") in wanted
+        }
+
+    def state_counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for t in self.tasks.values():
+            s = t.get("state", "?")
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    def worker_of(self, key: str) -> Any:
+        """Where a task is processing / which workers hold its data."""
+        t = self.tasks.get(key)
+        if t is None:
+            return None
+        return {
+            "state": t.get("state"),
+            "processing_on": t.get("processing_on"),
+            "who_has": t.get("who_has"),
+        }
+
+    def story(self, *keys: str) -> list:
+        """Transition-log rows touching any of the keys OR stimulus ids
+        (the post-mortem equivalent of Scheduler.story: a row matches on
+        its task key, its stimulus id, or any recommendation key)."""
+        keyset = set(keys)
+        out = []
+        for row in self.transition_log:
+            if not row:
+                continue
+            try:
+                key, _start, _finish, recs, stimulus_id = row[:5]
+            except ValueError:
+                if row[0] in keyset:
+                    out.append(row)
+                continue
+            if (
+                key in keyset
+                or stimulus_id in keyset
+                or (isinstance(recs, dict) and keyset & set(recs))
+            ):
+                out.append(row)
+        return out
+
+    def workers_summary(self) -> dict[str, dict]:
+        return {
+            addr: {
+                "status": w.get("status"),
+                "nthreads": w.get("nthreads"),
+                "processing": len(w.get("processing") or ()),
+                "has_what": len(w.get("has_what") or ()),
+                "nbytes": w.get("nbytes"),
+            }
+            for addr, w in self.workers.items()
+        }
+
+    def missing_workers(self, expected: Iterable[str]) -> list[str]:
+        """Expected addresses absent from the snapshot (post-mortems of
+        scale-down / crash events)."""
+        return [a for a in expected if a not in self.workers]
+
+    def __repr__(self) -> str:
+        return (
+            f"<DumpArtefact tasks={len(self.tasks)} "
+            f"workers={len(self.workers)} "
+            f"log={len(self.transition_log)} rows>"
+        )

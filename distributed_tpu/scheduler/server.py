@@ -1466,6 +1466,15 @@ class Scheduler(Server):
             "events": {t: len(evs) for t, evs in s.events.items()},
             "transition_log_length": len(s.transition_log),
         }
+        if "transition_log" not in (exclude or ()):
+            # the newest transition rows travel WITH the dump so a
+            # post-mortem can replay a task's story offline
+            # (diagnostics/cluster_dump.DumpArtefact.story; reference
+            # cluster_dump.py:111); exclude=['transition_log'] keeps
+            # periodic snapshots cheap
+            scheduler_info["transition_log"] = [
+                list(row) for row in list(s.transition_log)[-5000:]
+            ]
         worker_info = await self.broadcast(msg={"op": "identity"})
         return {"scheduler": scheduler_info, "workers": worker_info}
 

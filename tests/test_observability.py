@@ -421,3 +421,36 @@ async def test_performance_report_activity_seconds_spill_workload():
             assert "Activities (fine metrics)" in html
             for needle in ("disk-write", "network", "deserialize"):
                 assert needle in html, needle
+
+
+@gen_test(timeout=120)
+async def test_cluster_dump_artefact_roundtrip():
+    """dump_cluster_state -> DumpArtefact: offline post-mortem queries
+    (reference cluster_dump.py:111 DumpArtefact)."""
+    import os as _os
+    import tempfile
+
+    from distributed_tpu.diagnostics.cluster_dump import DumpArtefact
+
+    tdir = tempfile.TemporaryDirectory()
+    path = _os.path.join(tdir.name, "dump.json")
+    async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 1, range(6), pure=False)
+            assert await asyncio.wait_for(c.gather(futs), 60) == list(
+                range(1, 7)
+            )
+            await c.dump_cluster_state(path)
+
+    d = DumpArtefact.from_file(path)
+    assert len(d.workers) == 2
+    assert d.state_counts().get("memory", 0) >= 6
+    key = futs[0].key
+    info = d.worker_of(key)
+    assert info["state"] == "memory" and info["who_has"]
+    story = d.story(key)
+    assert story, "transition log rows for the key must travel in the dump"
+    assert any(row[0] == key for row in story)
+    summary = d.workers_summary()
+    assert all(v["nthreads"] == 1 for v in summary.values())
+    tdir.cleanup()
