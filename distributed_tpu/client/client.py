@@ -861,6 +861,32 @@ class Client:
                 json.dump(state, f, default=str, indent=1)
         return state
 
+    async def memory_trace_start(self, workers: list[str] | None = None) -> dict:
+        """Begin allocation tracing on workers (reference memray.py role;
+        stdlib tracemalloc — no extra dependency)."""
+        assert self.scheduler is not None
+        return await self.scheduler.broadcast(
+            msg={"op": "memory_trace", "action": "start"}, workers=workers
+        )
+
+    async def memory_trace_stop(self, workers: list[str] | None = None) -> dict:
+        assert self.scheduler is not None
+        return await self.scheduler.broadcast(
+            msg={"op": "memory_trace", "action": "stop"}, workers=workers
+        )
+
+    async def memory_trace_report(self, top_n: int = 10,
+                                  workers: list[str] | None = None) -> dict:
+        """Per-worker top allocation sites + data-store view, so leaked
+        interpreter memory is distinguishable from stored results."""
+        assert self.scheduler is not None
+        from distributed_tpu.protocol.serialize import nested_deserialize
+
+        return nested_deserialize(await self.scheduler.broadcast(
+            msg={"op": "memory_trace", "action": "report", "top_n": top_n},
+            workers=workers,
+        ))
+
     async def recreate_error_locally(self, future: Future) -> None:
         """Re-run a failed task in this process for debugging
         (reference recreate_tasks.py:15)."""
@@ -1144,18 +1170,31 @@ class as_completed:
         for f in futures:
             self.add(f)
 
-    def add(self, future: Future) -> None:
+    def add(self, future: Any) -> None:
         self.count += 1
 
-        async def _watch(f: Future = future):
-            st = f.client.futures.get(f.key)
-            if st is not None:
-                await st.event.wait()
+        async def _watch(f: Any = future):
+            if hasattr(f, "client"):  # task Future
+                st = f.client.futures.get(f.key)
+                if st is not None:
+                    await st.event.wait()
+                if self.with_results:
+                    try:
+                        result = await f.result()
+                    except BaseException as e:  # noqa: B036
+                        result = e
+                    await self.queue.put((f, result))
+                else:
+                    await self.queue.put(f)
+                return
+            # ActorFuture (or any awaitable handle): completion IS the
+            # await (reference actor futures iterate with as_completed
+            # next to task futures)
+            try:
+                result = await f
+            except BaseException as e:  # noqa: B036
+                result = e
             if self.with_results:
-                try:
-                    result = await f.result()
-                except BaseException as e:  # noqa: B036
-                    result = e
                 await self.queue.put((f, result))
             else:
                 await self.queue.put(f)

@@ -64,7 +64,77 @@ class Span:
         # (reference spans.py cumulative_worker_metrics)
         self.activity: defaultdict[tuple[str, str, str], float] = defaultdict(float)
 
+    def traverse(self) -> "Iterator[Span]":
+        """This span and every descendant, depth-first (reference
+        spans.py:74 Span.traverse_spans)."""
+        yield self
+        for child in self.children:
+            yield from child.traverse()
+
+    def cumulative(self) -> dict:
+        """Aggregates over the WHOLE subtree — nested spans roll up to
+        any depth (reference spans.py cumulative properties: a parent
+        span answers for everything submitted under it, not just tasks
+        annotated with its exact name)."""
+        states: defaultdict[str, int] = defaultdict(int)
+        activity: defaultdict[tuple[str, str, str], float] = defaultdict(
+            float
+        )
+        n_tasks = 0
+        compute = 0.0
+        nbytes = 0
+        start, stop = self.start, self.stop
+        for sp in self.traverse():
+            n_tasks += sp.n_tasks
+            compute += sp.compute_seconds
+            nbytes += sp.nbytes
+            for k, v in sp.states.items():
+                states[k] += v
+            for k, v in sp.activity.items():
+                activity[k] += v
+            if sp.start and (not start or sp.start < start):
+                start = sp.start
+            if sp.stop > stop:
+                stop = sp.stop
+        return {
+            "n_tasks": n_tasks,
+            "states": dict(states),
+            "compute_seconds": compute,
+            "nbytes": nbytes,
+            "start": start,
+            "stop": stop,
+            "activity": {"|".join(k): v for k, v in activity.items()},
+        }
+
     def to_dict(self) -> dict:
+        # bottom-up: build children first and fold their ALREADY-rolled
+        # cumulative dicts into this node's, so serializing a tree is
+        # O(N) instead of re-traversing every subtree per ancestor
+        children = [c.to_dict() for c in self.children]
+        cum = {
+            "n_tasks": self.n_tasks,
+            "states": dict(self.states),
+            "compute_seconds": self.compute_seconds,
+            "nbytes": self.nbytes,
+            "start": self.start,
+            "stop": self.stop,
+            "activity": {
+                "|".join(k): v for k, v in self.activity.items()
+            },
+        }
+        for cd in children:
+            cc = cd["cumulative"]
+            cum["n_tasks"] += cc["n_tasks"]
+            cum["compute_seconds"] += cc["compute_seconds"]
+            cum["nbytes"] += cc["nbytes"]
+            for k, v in cc["states"].items():
+                cum["states"][k] = cum["states"].get(k, 0) + v
+            for k, v in cc["activity"].items():
+                cum["activity"][k] = cum["activity"].get(k, 0.0) + v
+            if cc["start"] and (not cum["start"] or cc["start"] < cum["start"]):
+                cum["start"] = cc["start"]
+            if cc["stop"] > cum["stop"]:
+                cum["stop"] = cc["stop"]
         return {
             "id": self.id,
             "name": list(self.name),
@@ -77,7 +147,8 @@ class Span:
             "activity": {
                 "|".join(k): v for k, v in self.activity.items()
             },
-            "children": [c.to_dict() for c in self.children],
+            "cumulative": cum,
+            "children": children,
         }
 
 

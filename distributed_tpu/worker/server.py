@@ -188,6 +188,7 @@ class Worker(Server):
             "profile": self.get_profile,
             "versions": self.get_versions,
             "benchmark_hardware": self.benchmark_hardware_handler,
+            "memory_trace": self.memory_trace_handler,
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
@@ -397,19 +398,32 @@ class Worker(Server):
             # don't lose the activity samples to a transient blip
             self.fine_metrics.restore(delta)
 
+    def data_store_summary(self) -> dict:
+        """One source of truth for the data-store/spill snapshot
+        (metrics heartbeats and memory-trace reports both use it)."""
+        out = {
+            "keys": len(self.data),
+            "managed_bytes": self.state.nbytes_in_memory,
+        }
+        if hasattr(self.data, "spilled_count"):
+            out["spilled_count"] = self.data.spilled_count
+            out["spilled_bytes"] = self.data.slow_bytes
+        return out
+
     def metrics(self) -> dict:
+        store = self.data_store_summary()
         out = {
             "executing": len(self.state.executing),
             "ready": len(self.state.ready),
             "in_flight": len(self.state.in_flight_tasks),
-            "in_memory": len(self.data),
-            "memory": self.state.nbytes_in_memory,
+            "in_memory": store["keys"],
+            "memory": store["managed_bytes"],
         }
         if self.monitor is not None:
             out["host"] = self.monitor.recent()
-        if hasattr(self.data, "spilled_count"):
-            out["spilled_count"] = self.data.spilled_count
-            out["spilled_bytes"] = self.data.slow_bytes
+        if "spilled_count" in store:
+            out["spilled_count"] = store["spilled_count"]
+            out["spilled_bytes"] = store["spilled_bytes"]
         return out
 
     async def find_missing(self) -> None:
@@ -555,6 +569,19 @@ class Worker(Server):
         from distributed_tpu.versions import get_versions
 
         return get_versions()
+
+    async def memory_trace_handler(self, action: str = "report",
+                                   top_n: int = 10) -> dict:
+        """tracemalloc-backed memory introspection (the reference's
+        memray role, diagnostics/memray.py:26): action = start | stop |
+        report."""
+        from distributed_tpu.diagnostics import memtrace
+
+        if action == "start":
+            return memtrace.start_trace()
+        if action == "stop":
+            return memtrace.stop_trace()
+        return memtrace.worker_report(self, top_n=top_n)
 
     async def benchmark_hardware_handler(self) -> dict:
         """Tiny memory/disk bandwidth probes (reference worker benchmarks)."""

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import asyncio
+
 import pytest
 
 from distributed_tpu.client.client import Client
@@ -87,3 +89,38 @@ async def test_two_actors_independent():
             await b.increment()
             assert await a.value() == 1
             assert await b.value() == 51
+
+
+@gen_test(timeout=120)
+async def test_actor_futures_and_as_completed():
+    """ActorFuture surface (reference actor.py BaseActorFuture): method
+    calls return futures with done()/add_done_callback, awaitable, and
+    usable in as_completed next to task futures."""
+    from distributed_tpu.client.client import as_completed
+
+    async with await new_cluster(n_workers=2) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(Counter, actor=True)
+            counter = await fut.result()
+            af = counter.increment()
+            from distributed_tpu.client.actor import ActorFuture
+
+            assert isinstance(af, ActorFuture)
+            fired = []
+            af.add_done_callback(lambda t: fired.append(True))
+            assert await af == 1
+            assert af.done()
+            await asyncio.sleep(0)  # let the callback run
+            assert fired == [True]
+
+            # mixed as_completed: one task future + two actor futures
+            tfut = c.submit(lambda: 41, pure=False)
+            acs = as_completed([counter.increment(), tfut,
+                                counter.increment()], with_results=True)
+            got = []
+            async for f, result in acs:
+                got.append(result)
+            assert len(got) == 3
+            assert 41 in got          # the task future's result
+            assert {2, 3} <= set(got)  # the two increments
+            assert await counter.value() == 3

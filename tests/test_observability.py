@@ -454,3 +454,33 @@ async def test_cluster_dump_artefact_roundtrip():
     summary = d.workers_summary()
     assert all(v["nthreads"] == 1 for v in summary.values())
     tdir.cleanup()
+
+
+@gen_test(timeout=120)
+async def test_memory_trace_roundtrip():
+    """tracemalloc-backed memory introspection (reference memray role):
+    start -> allocate-heavy workload -> report shows allocation sites
+    and the data-store view -> stop."""
+    import numpy as np
+
+    def allocate(i):
+        return np.ones((256, 256)) * i  # ~0.5 MB per task
+
+    async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.memory_trace_start()
+            futs = c.map(allocate, range(6), pure=False)
+            await asyncio.wait_for(c.gather(futs), 60)
+            reports = await c.memory_trace_report(top_n=5)
+            assert len(reports) == 2
+            for addr, rep in reports.items():
+                assert rep["status"] == "OK", (addr, rep)
+                assert rep["traced_bytes"] > 0
+                assert rep["top"] and all(
+                    "site" in t and t["bytes"] >= 0 for t in rep["top"]
+                )
+                assert rep["data_store"]["keys"] >= 0
+            stopped = await c.memory_trace_stop()
+            assert all(
+                r["tracing"] is False for r in stopped.values()
+            )

@@ -180,3 +180,43 @@ async def test_context_meter_user_samples():
                        for k, v in fine.items()), fine
             assert any("custom-bytes|bytes" in k and v == 3 * 1234
                        for k, v in fine.items()), fine
+
+
+@gen_test(timeout=120)
+async def test_span_tree_cumulative_aggregation():
+    """Nested spans roll up to arbitrary depth (reference spans.py
+    cumulative properties): a parent span's cumulative() covers every
+    task submitted under ANY descendant."""
+    from distributed_tpu.diagnostics.spans import span
+
+    async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            with span("flow"):
+                with span("stage-a"):
+                    fa = c.map(lambda x: x + 1, range(4), pure=False)
+                with span("stage-a", "inner"):
+                    fi = c.map(lambda x: x * 2, range(3), pure=False)
+                with span("stage-b"):
+                    fb = c.map(lambda x: x - 1, range(5), pure=False)
+            await asyncio.wait_for(c.gather(fa + fi + fb), 60)
+
+            ext = cluster.scheduler.spans
+            flow = ext.spans[("flow",)]
+            stage_a = ext.spans[("flow", "stage-a")]
+            inner = ext.spans[("flow", "stage-a", "inner")]
+
+            assert inner.n_tasks == 3
+            # direct counts stay per-node ...
+            assert stage_a.n_tasks == 4
+            # ... cumulative rolls descendants up, to any depth
+            assert stage_a.cumulative()["n_tasks"] == 7
+            cum = flow.cumulative()
+            assert cum["n_tasks"] == 12
+            assert cum["states"].get("memory", 0) == 12
+            assert [c.name for c in flow.children] == [
+                ("flow", "stage-a"), ("flow", "stage-b"),
+            ]
+            # the tree serializes with cumulative sections
+            d = flow.to_dict()
+            assert d["cumulative"]["n_tasks"] == 12
+            assert d["children"][0]["cumulative"]["n_tasks"] == 7
