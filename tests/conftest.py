@@ -34,7 +34,7 @@ def loop():
     loop.close()
 
 
-def gen_test(timeout: float = 60):
+def gen_test(timeout: float = 120):
     """Run an async test on a fresh event loop (reference utils_test.py:708)."""
 
     def decorator(fn):

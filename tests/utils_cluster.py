@@ -87,7 +87,7 @@ async def assert_no_cluster_leaks(fds_before: int,
 def gen_cluster(
     nthreads: list[int] | None = None,
     client: bool = True,
-    timeout: float = 60,
+    timeout: float = 120,
     worker_cls: Any = None,
     scheduler_kwargs: dict | None = None,
     worker_kwargs: dict | None = None,
@@ -224,7 +224,7 @@ class BlockedExecute(Worker):
             await self.block_execute_exit.wait()
 
 
-async def wait_for(predicate, timeout: float = 10, interval: float = 0.01):
+async def wait_for(predicate, timeout: float = 30, interval: float = 0.01):
     """Poll ``predicate()`` until truthy (reference utils_test.py
     async_poll_for)."""
     deadline = asyncio.get_running_loop().time() + timeout
