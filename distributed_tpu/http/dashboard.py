@@ -260,6 +260,8 @@ DASHBOARD_HTML = """<!doctype html>
 <section><b>Profile</b> <span class=muted>(merged executor flame graph;
  click to refresh)</span><svg id=flame height=200 viewBox="0 0 1000 200"
   onclick="drawFlame()"></svg></section>
+<section><b>Spans</b> <span class=muted>(workload tags; cumulative =
+ subtree roll-up)</span><div id=spans></div></section>
 <script>
 const colors={};let hue=0;
 function color(n){if(!(n in colors)){colors[n]=`hsl(${(hue=hue+67)%360} 60% 55%)`}return colors[n]}
@@ -282,10 +284,13 @@ async function tick(){
     <td class=num>${w.nthreads}</td><td class=num>${w.processing}</td>
     <td class=num>${w.stored}</td>
     <td class=num>${(w.managed_bytes/1e6).toFixed(1)} MB</td>
-    <td class=num>${w.occupancy}</td><td>${esc(w.status)}</td></tr>`).join('');
+    <td class=num>${w.occupancy}</td><td>${esc(w.status)}</td>
+    <td>${['health','metrics','profile','info'].map(p=>
+      `<a href="/workers/${encodeURIComponent(w.name)}/${p}">${p}</a>`
+    ).join(' ')}</td></tr>`).join('');
   document.getElementById('workers').innerHTML=
     `<table><tr><th>name</th><th>address</th><th>threads</th><th>proc</th>
-     <th>stored</th><th>managed</th><th>occupancy</th><th>status</th></tr>${rows}</table>`;
+     <th>stored</th><th>managed</th><th>occupancy</th><th>status</th><th>pages</th></tr>${rows}</table>`;
   // task stream: rows per worker, bars per compute startstop
   const workersSeen=[...new Set(stream.map(r=>r.worker))];
   let t0=Infinity,t1=-Infinity;
@@ -352,6 +357,25 @@ async function drawFlame(){
   document.getElementById('flame').innerHTML=out;
  }catch(e){}
 }
-tick();drawGraph();drawFlame();setInterval(drawFlame,5000);
+async function drawSpans(){
+ try{
+  const sp=await j('/api/v1/spans');
+  function row(n,depth){
+   const cum=n.cumulative||n;
+   return `<tr><td>${'&nbsp;'.repeat(depth*3)}${esc(n.name[n.name.length-1]||'')}</td>
+     <td class=num>${n.n_tasks}</td><td class=num>${cum.n_tasks}</td>
+     <td class=num>${(cum.compute_seconds||0).toFixed(2)}</td>
+     <td class=num>${((cum.nbytes||0)/1e6).toFixed(1)} MB</td></tr>`
+     + (n.children||[]).map(c=>row(c,depth+1)).join('');
+  }
+  const roots=(sp||[]).filter(n=>n.name.length===1);
+  document.getElementById('spans').innerHTML = roots.length
+    ? `<table><tr><th>span</th><th>tasks</th><th>cum tasks</th>
+       <th>cum compute s</th><th>cum bytes</th></tr>${roots.map(n=>row(n,0)).join('')}</table>`
+    : '<span class=muted>no spans yet — tag work with span("name")</span>';
+ }catch(e){}
+ setTimeout(drawSpans,3000);
+}
+tick();drawGraph();drawFlame();drawSpans();setInterval(drawFlame,5000);
 </script></body></html>
 """
