@@ -24,19 +24,16 @@ def _tree_sum(xs):
     return sum(xs)
 
 
-@gen_test(timeout=280)
-async def test_chaos_kill_workers_under_load():
-    """5k-task workload while a KillWorker chaos clock (exponential,
-    mean ~0.8 s) closes a random worker and replaces it.  Done means:
-    every result correct, no stuck tasks, scheduler quiescent."""
-    rng = random.Random(42)
-    n_tasks = 5000
+async def _chaos_soak(n_tasks: int, protocol: str, seed: int = 42,
+                      mean_kill_s: float = 0.8):
+    rng = random.Random(seed)
     with config.set({
         "scheduler.allowed-failures": 100,  # deaths are the POINT here
         "scheduler.jax.enabled": False,
     }):
         async with LocalCluster(
             n_workers=8, threads_per_worker=1,
+            protocol=protocol,
             scheduler_kwargs={"validate": True},
             worker_kwargs={"validate": True},
         ) as cluster:
@@ -49,7 +46,7 @@ async def test_chaos_kill_workers_under_load():
                     while not stop.is_set():
                         try:
                             await asyncio.wait_for(
-                                stop.wait(), rng.expovariate(1 / 0.8)
+                                stop.wait(), rng.expovariate(1 / mean_kill_s)
                             )
                             return
                         except asyncio.TimeoutError:
@@ -86,3 +83,20 @@ async def test_chaos_kill_workers_under_load():
                 s = cluster.scheduler
                 for ts in s.state.tasks.values():
                     assert ts.state in ("memory", "released", "forgotten"), ts
+
+
+@gen_test(timeout=280)
+async def test_chaos_kill_workers_under_load():
+    """5k-task workload while a chaos clock (exponential, mean ~0.8 s)
+    closes a random worker and replaces it.  Done means: every result
+    correct, no stuck tasks, scheduler quiescent."""
+    await _chaos_soak(5000, "inproc")
+
+
+@gen_test(timeout=280)
+async def test_chaos_kill_workers_under_load_tcp():
+    """The same soak with every comm over REAL sockets: worker death now
+    severs TCP streams mid-frame, so the recovery paths digest framing
+    truncation, half-open connections, and reconnect races that inproc
+    can never produce."""
+    await _chaos_soak(1500, "tcp", seed=7, mean_kill_s=1.0)
