@@ -610,8 +610,7 @@ def bench_device(durations, out_bytes, src, dst):
     import numpy as np
 
     from distributed_tpu.ops.leveled import (
-        pack_graph,
-        place_graph_leveled,
+        place_graph_streamed,
         validate_leveled,
     )
 
@@ -622,14 +621,21 @@ def bench_device(durations, out_bytes, src, dst):
     # warm up: builds the native library and compiles every wave bucket
     # (compile excluded from the measurement, like the reference excludes
     # interpreter startup)
-    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BANDWIDTH)
-    res = place_graph_leveled(packed, nthreads, occ0, running)
+    packed, res = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BANDWIDTH,
+    )
 
+    # streamed driver: pack fill + H2D upload + waves pipeline; only the
+    # topology phase is serial (reported as "pack")
+    tm: dict = {}
     t0 = time.perf_counter()
-    packed = pack_graph(durations, out_bytes, src, dst, bandwidth=BANDWIDTH)
-    t1 = time.perf_counter()
-    res = place_graph_leveled(packed, nthreads, occ0, running)
+    packed, res = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BANDWIDTH, timings=tm,
+    )
     t2 = time.perf_counter()
+    t1 = t0 + tm.get("topo_s", 0.0)
 
     validate_leveled(packed, res, src, dst, running)
     counts = np.bincount(res.assignment, minlength=N_WORKERS)

@@ -124,6 +124,21 @@ def load() -> ctypes.CDLL | None:
             _i32p, _i32p, _i32p,
             _f32p, _i32p, _i32p, _f32p, _f32p, _f32p,
         ]
+        lib.graphpack_topo.restype = ctypes.c_int64
+        lib.graphpack_topo.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            _f32p, _i32p, _i32p,
+            _i32p, _i32p, _i32p,
+            _i32p, _i32p, _f32p, _i32p, _i32p,
+        ]
+        lib.graphpack_fill.restype = None
+        lib.graphpack_fill.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            _f32p, _f32p, _i32p, _i32p,
+            _i32p, _i32p, _f32p, _i32p,
+            ctypes.c_double, ctypes.c_double,
+            _f32p, _i32p, _i32p, _f32p, _f32p, _f32p,
+        ]
         lib.unpack_assignment.restype = None
         lib.unpack_assignment.argtypes = [
             ctypes.c_int64, _i32p, _i32p, _i32p,

@@ -136,32 +136,52 @@ int64_t graphpack(
 // the transfer model here (rather than via numpy post-passes over 1M-row
 // arrays, which cost more than the whole pack): co-location with a dep
 // saves one latency; any other placement pays one per dependency.
-int64_t graphpack_full(
+// Streamed-pack phase 1: topology only.  Emits everything the python
+// driver needs to plan waves and allocate device buffers (level, perm,
+// offsets) plus the original-order per-task reductions the fill pass
+// consumes (heavy, heavy2, dep_total, indeg) and the inverse
+// permutation.  Returns n_levels, -1 on cycle.  All buffers
+// caller-allocated, length T (offsets: T+1).
+int64_t graphpack_topo(
     int64_t T, int64_t E,
-    const float* durations, const float* out_bytes,
+    const float* out_bytes,
     const int32_t* src, const int32_t* dst,
-    double inv_bandwidth, double latency,
     int32_t* level, int32_t* perm, int32_t* offsets,
-    float* dur_s, int32_t* heavy_s, int32_t* heavy2_s,
-    float* xp_s, float* xp2_s, float* xa_s)
+    int32_t* heavy, int32_t* heavy2, float* dep_total,
+    int32_t* indeg, int32_t* inv)
 {
-    std::vector<int32_t> heavy(T), heavy2(T);
-    std::vector<float> dep_total(T);
-    std::vector<int32_t> indeg(T, 0);
+    if (T <= 0) return 0;
+    for (int64_t t = 0; t < T; ++t) indeg[t] = 0;
     for (int64_t e = 0; e < E; ++e) {
         int32_t s = src[e], d = dst[e];
         if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
         indeg[d] += 1;
     }
     int64_t n_levels = graphpack(T, E, out_bytes, src, dst,
-                                 level, perm, heavy.data(), heavy2.data(),
-                                 dep_total.data(), offsets);
+                                 level, perm, heavy, heavy2,
+                                 dep_total, offsets);
     if (n_levels < 0) return -1;
-    std::vector<int32_t> inv(T);
     for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
+    return n_levels;
+}
+
+// Streamed-pack phase 2: fill sorted rows [i0, i1) of the per-task
+// arrays the device kernel consumes.  Chunked so the python driver can
+// overlap later fills with the (async) upload of earlier chunks — on
+// tunneled backends the pack CPU hides entirely behind the H2D wire.
+void graphpack_fill(
+    int64_t i0, int64_t i1,
+    const float* durations, const float* out_bytes,
+    const int32_t* perm, const int32_t* inv,
+    const int32_t* heavy, const int32_t* heavy2,
+    const float* dep_total, const int32_t* indeg,
+    double inv_bandwidth, double latency,
+    float* dur_s, int32_t* heavy_s, int32_t* heavy2_s,
+    float* xp_s, float* xp2_s, float* xa_s)
+{
     float ibw = (float)inv_bandwidth;
     float lat = (float)latency;
-    for (int64_t i = 0; i < T; ++i) {
+    for (int64_t i = i0; i < i1; ++i) {
         int32_t t = perm[i];
         dur_s[i] = durations[t];
         int32_t h = heavy[t];
@@ -176,6 +196,28 @@ int64_t graphpack_full(
         xp_s[i] = (dep_total[t] - hb) * ibw + extra;
         xp2_s[i] = (dep_total[t] - h2b) * ibw + extra;
     }
+}
+
+int64_t graphpack_full(
+    int64_t T, int64_t E,
+    const float* durations, const float* out_bytes,
+    const int32_t* src, const int32_t* dst,
+    double inv_bandwidth, double latency,
+    int32_t* level, int32_t* perm, int32_t* offsets,
+    float* dur_s, int32_t* heavy_s, int32_t* heavy2_s,
+    float* xp_s, float* xp2_s, float* xa_s)
+{
+    std::vector<int32_t> heavy(T), heavy2(T), indeg(T), inv(T);
+    std::vector<float> dep_total(T);
+    int64_t n_levels = graphpack_topo(
+        T, E, out_bytes, src, dst, level, perm, offsets,
+        heavy.data(), heavy2.data(), dep_total.data(),
+        indeg.data(), inv.data());
+    if (n_levels < 0) return -1;
+    graphpack_fill(0, T, durations, out_bytes, perm, inv.data(),
+                   heavy.data(), heavy2.data(), dep_total.data(),
+                   indeg.data(), inv_bandwidth, latency,
+                   dur_s, heavy_s, heavy2_s, xp_s, xp2_s, xa_s);
     return n_levels;
 }
 

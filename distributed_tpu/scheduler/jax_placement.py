@@ -605,11 +605,15 @@ class JaxPlacement:
                 for i, key in enumerate(keys)
             }
 
-        from distributed_tpu.ops.leveled import pack_graph, place_graph_leveled
+        from distributed_tpu.ops.leveled import place_graph_streamed
 
-        packed = pack_graph(durations, out_bytes, src, dst,
-                            bandwidth=bandwidth)
-        result = place_graph_leveled(packed, nthreads, occupancy, running)
+        # streamed driver: on large graphs the pack fill and H2D upload
+        # pipeline, so the plan lands one wire-crossing sooner (falls
+        # back to pack+place below the streaming threshold)
+        packed, result = place_graph_streamed(
+            durations, out_bytes, src, dst, nthreads, occupancy, running,
+            bandwidth=bandwidth, latency=transfer_latency,
+        )
         assignment = result.assignment
         nw = len(addrs)
         n = len(keys)

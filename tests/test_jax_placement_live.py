@@ -57,8 +57,22 @@ async def test_async_plan_lands_mid_execution():
     placement = JaxPlacement(min_batch=4, min_workers=0, min_transfer_ratio=0)
     assert not placement.sync
 
+    # warm the partitioner jit off-line: the async plan's sleep slack
+    # below must cover planning only, not the first XLA-CPU compile
+    # (~seconds on a loaded box — the plan would land after the second
+    # layer was already oracle-placed and plan_hits would read 0)
+    import numpy as np
+
+    JaxPlacement._plan_from_arrays(
+        [f"warm{i}" for i in range(8)],
+        np.ones(8, np.float32), np.full(8, 1e6, np.float32),
+        np.arange(4, dtype=np.int32), np.arange(4, 8, dtype=np.int32),
+        np.ones(2, np.int32), np.zeros(2, np.float32),
+        np.ones(2, bool), ["w0", "w1"], 1e8, 0.001,
+    )
+
     def slow_inc(x):
-        _time.sleep(0.3)
+        _time.sleep(0.5)
         return x + 1
 
     async with LocalCluster(
