@@ -812,13 +812,19 @@ class Client:
             keys=[f.key for f in futures], n=n, workers=workers
         )
 
-    async def register_plugin(self, plugin: Any, name: str | None = None) -> Any:
+    async def register_plugin(self, plugin: Any, name: str | None = None,
+                              nanny: bool | None = None) -> Any:
         """Install a Scheduler/Worker/Nanny plugin cluster-wide
-        (reference client.py register_plugin)."""
+        (reference client.py register_plugin).
+
+        ``nanny`` overrides the isinstance routing (reference has the
+        same parameter): a NannyPlugin like ``UploadDirectory`` on a
+        nanny-LESS cluster would otherwise broadcast to zero nannies and
+        silently ship nothing — pass ``nanny=False`` to run its setup on
+        the workers instead."""
         from distributed_tpu.diagnostics.plugin import (
             NannyPlugin,
             SchedulerPlugin,
-            WorkerPlugin,
         )
 
         assert self.scheduler is not None
@@ -827,14 +833,24 @@ class Client:
             return await self.scheduler.register_scheduler_plugin(
                 plugin=Serialize(plugin), name=name
             )
-        if isinstance(plugin, NannyPlugin):
-            return await self.scheduler.register_nanny_plugin(
+        if nanny if nanny is not None else isinstance(plugin, NannyPlugin):
+            resp = await self.scheduler.register_nanny_plugin(
                 plugin=Serialize(plugin), name=name
             )
-        # default: worker plugin (reference treats unknown as worker plugin)
-        return await self.scheduler.register_worker_plugin(
-            plugin=Serialize(plugin), name=name
-        )
+        else:
+            # default: worker plugin (reference treats unknown as one)
+            resp = await self.scheduler.register_worker_plugin(
+                plugin=Serialize(plugin), name=name
+            )
+        # a failing setup() must not pass silently: the broadcast result
+        # carries per-node error_message dicts (reference re-raises too)
+        if isinstance(resp, dict):
+            for r in resp.values():
+                if isinstance(r, dict) and r.get("status") == "error":
+                    from distributed_tpu.rpc.core import raise_remote_error
+
+                    raise_remote_error(r)
+        return resp
 
     async def unregister_worker_plugin(self, name: str) -> Any:
         assert self.scheduler is not None
@@ -886,6 +902,33 @@ class Client:
             msg={"op": "memory_trace", "action": "report", "top_n": top_n},
             workers=workers,
         ))
+
+    async def device_profile_start(
+        self, workers: list[str] | None = None,
+        logdir: str | None = None,
+    ) -> dict:
+        """Begin an XLA device-timeline trace on workers (the
+        reference's low-level profiler role, profile.py:550 — see
+        diagnostics/device_profile.py).  Tasks executed while tracing
+        carry their key as a device-timeline annotation."""
+        assert self.scheduler is not None
+        return await self.scheduler.broadcast(
+            msg={"op": "device_profile", "action": "start",
+                 "logdir": logdir},
+            workers=workers,
+        )
+
+    async def device_profile_stop(
+        self, workers: list[str] | None = None
+    ) -> dict:
+        """End the device trace; each worker reports its trace directory
+        (TensorBoard/XProf ``plugins/profile`` format) and the files
+        captured."""
+        assert self.scheduler is not None
+        return await self.scheduler.broadcast(
+            msg={"op": "device_profile", "action": "stop"},
+            workers=workers,
+        )
 
     async def recreate_error_locally(self, future: Future) -> None:
         """Re-run a failed task in this process for debugging

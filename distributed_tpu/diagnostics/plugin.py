@@ -8,7 +8,8 @@
   subprocess
 
 Built-ins mirror the reference's: ``Environ`` (:852), ``UploadFile``
-(:738), ``ForwardLoggingPlugin`` (:771), ``PipInstall`` (:637), and the
+(:738), ``UploadDirectory`` (:863), ``ForwardOutput`` (:992),
+``ForwardLoggingPlugin`` (:771), ``PipInstall`` (:637), and the
 ``KillWorker`` chaos plugin (chaos.py:14).
 """
 
@@ -104,6 +105,164 @@ class UploadFile(WorkerPlugin):
                     importlib.reload(sys.modules[modname])
                 else:
                     importlib.import_module(modname)
+
+
+class UploadDirectory(NannyPlugin):
+    """Ship a whole local directory tree to every worker (reference
+    plugin.py:863).  The directory is zipped client-side at construction
+    (``__pycache__`` and ``.git`` pruned), unpacked under the node's
+    working directory, and optionally put on ``sys.path`` so uploaded
+    packages import.
+
+    A NannyPlugin like the reference's, with ``restart=True`` (also like
+    the reference): the nanny cycles its worker subprocess after setup,
+    so the NEW worker process starts with the files on disk and imports
+    them fresh — extracting in the nanny alone would never reach an
+    already-running child interpreter.  On nanny-less clusters register
+    with ``nanny=False``: ``setup`` only needs an object with a
+    ``local_directory``, so the same instance works as a worker plugin
+    (``Client.register_plugin(UploadDirectory(p), nanny=False)``).
+    """
+
+    name = "upload-directory"
+    restart = True
+
+    def __init__(self, path: str, update_path: bool = True,
+                 restart: bool = True,
+                 skip_words: tuple = ("__pycache__", ".git", ".github",
+                                      ".pytest_cache", "tests.egg-info")):
+        import io
+        import zipfile
+
+        path = os.path.abspath(path)
+        self.dirname = os.path.basename(path.rstrip(os.sep))
+        self.update_path = update_path
+        self.restart = restart
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs if d not in skip_words]
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    rel = os.path.join(
+                        self.dirname, os.path.relpath(full, path)
+                    )
+                    z.write(full, rel)
+        self.data = buf.getvalue()
+
+    def setup(self, nanny: Any = None, worker: Any = None) -> None:
+        # dual-role: nannies call setup(nanny=...), workers (nanny=False
+        # registration) call setup(worker=...)
+        import io
+        import zipfile
+
+        node = nanny if nanny is not None else worker
+        base = getattr(node, "local_directory", None) or os.getcwd()
+        os.makedirs(base, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(self.data)) as z:
+            z.extractall(base)
+        if self.update_path and base not in sys.path:
+            sys.path.insert(0, base)
+
+
+class ForwardOutput(WorkerPlugin):
+    """Forward the worker's stdout/stderr to clients (reference
+    plugin.py:992 ``ForwardOutput``).
+
+    Wraps ``sys.stdout``/``sys.stderr`` with a tee that both writes
+    through locally and ships complete lines to the scheduler event log
+    under the ``"print"`` topic; ``Client.subscribe_topic("print", ...)``
+    (or the default event stream) surfaces task ``print()`` output at
+    the client, which is how users debug remote tasks.
+
+    NOTE: streams are process-global.  With an in-process LocalCluster
+    every worker shares one interpreter, so register this on a single
+    worker (the reference has the same constraint and warns about
+    duplicated output); nanny/process workers each own their streams.
+    """
+
+    name = "forward-output"
+
+    def __init__(self, topic: str = "print"):
+        self.topic = topic
+        self._saved: tuple | None = None
+
+    def setup(self, worker: Any) -> None:
+        plugin = self
+        # task print() runs on executor threads; BatchedSend.send wakes
+        # an asyncio.Event, which is only safe from the loop thread —
+        # hop every line through call_soon_threadsafe
+        loop = asyncio.get_event_loop()
+        import threading
+
+        class _Tee:
+            def __init__(self, inner: Any, stream: str) -> None:
+                self._inner = inner
+                self._stream = stream
+                self._buf = ""
+                # concurrent print() from several executor threads: the
+                # read-split-assign on _buf is not atomic under the GIL
+                self._lock = threading.Lock()
+
+            def _send(self, line: str) -> None:
+                try:
+                    worker.batched_stream.send({
+                        "op": "log-event",
+                        "topic": plugin.topic,
+                        "msg": {"stream": self._stream, "text": line,
+                                "worker": worker.address},
+                    })
+                except Exception:
+                    pass
+
+            def write(self, data: str) -> int:
+                n = self._inner.write(data)
+                lines = []
+                with self._lock:
+                    self._buf += data
+                    while "\n" in self._buf:
+                        line, self._buf = self._buf.split("\n", 1)
+                        lines.append(line)
+                for line in lines:
+                    try:
+                        loop.call_soon_threadsafe(self._send, line)
+                    except RuntimeError:
+                        pass  # loop closed mid-shutdown
+                return n
+
+            def flush(self) -> None:
+                self._inner.flush()
+                # an explicit flush is the user saying "ship it now":
+                # forward any unterminated partial line (print(end=''),
+                # progress bars) instead of holding it forever
+                with self._lock:
+                    pending, self._buf = self._buf, ""
+                if pending:
+                    try:
+                        loop.call_soon_threadsafe(self._send, pending)
+                    except RuntimeError:
+                        pass
+
+            def __getattr__(self, name: str) -> Any:
+                return getattr(self._inner, name)
+
+        self._saved = (sys.stdout, sys.stderr)
+        self._tees = (_Tee(sys.stdout, "stdout"), _Tee(sys.stderr, "stderr"))
+        sys.stdout, sys.stderr = self._tees  # type: ignore[assignment]
+
+    def teardown(self, worker: Any) -> None:
+        if self._saved is not None:
+            for tee in self._tees:
+                tee.flush()  # ship any unterminated partial line
+            # only unwind if OUR tee is still installed: with several
+            # in-process workers each wrapping in turn, blindly restoring
+            # the saved streams would clobber a later plugin's tee (or
+            # resurrect an earlier one)
+            if sys.stdout is self._tees[0]:
+                sys.stdout = self._saved[0]
+            if sys.stderr is self._tees[1]:
+                sys.stderr = self._saved[1]
+            self._saved = None
 
 
 class ForwardLoggingPlugin(WorkerPlugin):

@@ -107,7 +107,19 @@ class Nanny(Server):
             "plugin_remove": self.plugin_remove,
         }
         self.plugins: dict[str, Any] = {}
+        self._local_directory: Any | None = None
         super().__init__(handlers=handlers, name=name, **server_kwargs)
+
+    @property
+    def local_directory(self) -> str:
+        """Per-nanny scratch directory (lazy WorkSpace claim) — the
+        extraction target for NannyPlugins like UploadDirectory, kept
+        out of the process CWD and purged when stale."""
+        if self._local_directory is None:
+            from distributed_tpu.utils.diskutils import WorkSpace
+
+            self._local_directory = WorkSpace().new_work_dir(prefix="nanny")
+        return self._local_directory.path
 
     # ------------------------------------------------------------ lifecycle
 

@@ -20,6 +20,7 @@ from typing import Any
 
 from distributed_tpu import config
 from distributed_tpu.comm.core import Comm, connect
+from distributed_tpu.diagnostics import device_profile
 from distributed_tpu.exceptions import CommClosedError, Reschedule, WorkerClosedError
 from distributed_tpu.graph.spec import Key
 from distributed_tpu.protocol.serialize import Serialize, unwrap
@@ -173,6 +174,7 @@ class Worker(Server):
         self.plugins: dict[str, Any] = {}
         self._pubsub_subs: dict[str, list] = {}
         self._async_instructions: set[asyncio.Task] = set()
+        self._local_directory: Any | None = None
         from distributed_tpu.worker.metrics import FineMetrics
 
         self.fine_metrics = FineMetrics()
@@ -189,6 +191,7 @@ class Worker(Server):
             "versions": self.get_versions,
             "benchmark_hardware": self.benchmark_hardware_handler,
             "memory_trace": self.memory_trace_handler,
+            "device_profile": self.device_profile_handler,
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
@@ -570,6 +573,21 @@ class Worker(Server):
 
         return get_versions()
 
+    @property
+    def local_directory(self) -> str:
+        """Per-worker scratch directory (reference worker.py
+        local_directory): claimed lazily from the managed WorkSpace so
+        plugins (UploadDirectory) and user tasks never collide in the
+        process CWD — many workers on one host each get their own dir
+        with stale-dir purge on restart."""
+        if self._local_directory is None:
+            from distributed_tpu.utils.diskutils import WorkSpace
+
+            self._local_directory = WorkSpace().new_work_dir(
+                prefix="worker"
+            )
+        return self._local_directory.path
+
     async def memory_trace_handler(self, action: str = "report",
                                    top_n: int = 10) -> dict:
         """tracemalloc-backed memory introspection (the reference's
@@ -582,6 +600,16 @@ class Worker(Server):
         if action == "stop":
             return memtrace.stop_trace()
         return memtrace.worker_report(self, top_n=top_n)
+
+    async def device_profile_handler(self, action: str = "stop",
+                                     logdir: str | None = None) -> dict:
+        """XLA device-timeline tracing (the reference's low-level
+        profiler role, profile.py:550): action = start | stop.  While a
+        trace runs, every executed task is annotated with its key on the
+        device timeline (see diagnostics/device_profile.py)."""
+        if action == "start":
+            return device_profile.start(logdir)
+        return device_profile.stop()
 
     async def benchmark_hardware_handler(self) -> dict:
         """Tiny memory/disk bandwidth probes (reference worker benchmarks)."""
@@ -846,6 +874,12 @@ class Worker(Server):
                         set_thread_worker(self, key)
                         t0 = _perf()
                         try:
+                            if device_profile.active():
+                                # device trace running: mark this task's
+                                # span on the XLA timeline so its device
+                                # ops group under the task key
+                                with device_profile.annotate(key):
+                                    return fn(*args, **kwargs)
                             return fn(*args, **kwargs)
                         finally:
                             self._note_inner_duration(_pre, _perf() - t0)

@@ -484,3 +484,45 @@ async def test_memory_trace_roundtrip():
             assert all(
                 r["tracing"] is False for r in stopped.values()
             )
+
+
+@gen_test(timeout=120)
+async def test_device_profile_roundtrip():
+    """XLA device-timeline tracing (the reference's low-level profiler
+    role, profile.py:550): start -> run jax work (tasks annotated with
+    their keys on the device timeline) -> stop reports the trace
+    artifact files.  One worker: the XLA profiler is process-global, so
+    in-process clusters trace from a single worker (documented in
+    diagnostics/device_profile.py)."""
+    from distributed_tpu.diagnostics import device_profile
+
+    if not device_profile.available():  # pragma: no cover
+        import pytest
+
+        pytest.skip("jax profiler unavailable")
+
+    def devwork(i):
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.arange(64.0) * i))
+
+    async with LocalCluster(n_workers=1, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            started = await c.device_profile_start()
+            assert all(r["status"] == "OK" for r in started.values()), started
+            # a second start must fail cleanly, not wedge the profiler
+            again = await c.device_profile_start()
+            assert all(r["status"] == "error" for r in again.values())
+            futs = c.map(devwork, range(4), pure=False)
+            assert await asyncio.wait_for(c.gather(futs), 60) == [
+                float(sum(range(64)) * i) for i in range(4)
+            ]
+            stopped = await c.device_profile_stop()
+            for rep in stopped.values():
+                assert rep["status"] == "OK", rep
+                # the XLA profiler wrote its TensorBoard/XProf artifact
+                assert rep["files"], rep
+                assert any("plugins/profile" in f for f in rep["files"])
+            # stop without a trace running errors cleanly
+            idle = await c.device_profile_stop()
+            assert all(r["status"] == "error" for r in idle.values())

@@ -267,3 +267,98 @@ async def test_upload_file_imports_on_workers():
             stray = os.path.join(os.getcwd(), "dtpu_uploaded_mod.py")
             if os.path.exists(stray):
                 os.remove(stray)
+
+
+@gen_test()
+async def test_upload_directory_ships_package():
+    """UploadDirectory zips a package tree client-side and unpacks it on
+    the node, importable by tasks (reference plugin.py:863)."""
+    import os
+    import sys
+    import tempfile
+    import textwrap
+
+    from distributed_tpu.diagnostics.plugin import UploadDirectory
+
+    with tempfile.TemporaryDirectory() as td:
+        pkg = os.path.join(td, "dtpu_uploaded_pkg")
+        os.makedirs(os.path.join(pkg, "__pycache__"))
+        with open(os.path.join(pkg, "__init__.py"), "w") as f:
+            f.write("from .mod import five\n")
+        with open(os.path.join(pkg, "mod.py"), "w") as f:
+            f.write(textwrap.dedent("""
+                def five():
+                    return 5
+                """))
+        # junk that must be pruned from the zip
+        with open(os.path.join(pkg, "__pycache__", "x.pyc"), "wb") as f:
+            f.write(b"junk")
+        plugin = UploadDirectory(pkg)
+        assert b"x.pyc" not in plugin.data
+
+        # nanny-less cluster: nanny=False routes the NannyPlugin to the
+        # workers (the default isinstance routing would broadcast to the
+        # zero nannies and silently ship nothing)
+        added = []
+        try:
+            async with await new_cluster(n_workers=1) as cluster:
+                async with Client(cluster.scheduler_address) as c:
+                    await c.register_plugin(plugin, nanny=False)
+                    w = cluster.workers[0]
+                    added.append(getattr(w, "local_directory", os.getcwd()))
+
+                    def use_it(x):
+                        import dtpu_uploaded_pkg
+
+                        return dtpu_uploaded_pkg.five() + x
+
+                    assert await c.submit(use_it, 1).result() == 6
+        finally:
+            import sys
+
+            sys.modules.pop("dtpu_uploaded_pkg", None)
+            sys.modules.pop("dtpu_uploaded_pkg.mod", None)
+            for base in added:
+                if base in sys.path:
+                    sys.path.remove(base)
+
+
+@gen_test()
+async def test_forward_output_streams_prints_to_client():
+    """ForwardOutput tees worker stdout/stderr into the scheduler event
+    log under the "print" topic; a subscribed client sees task print()
+    lines (reference plugin.py:992)."""
+    from distributed_tpu.diagnostics.plugin import ForwardOutput
+
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            seen: list = []
+            got = asyncio.Event()
+
+            def on_print(msg):
+                # worker log-events arrive wrapped {"worker":, "msg":}
+                inner = msg.get("msg") if isinstance(msg, dict) else None
+                if isinstance(inner, dict):
+                    seen.append(inner)
+                    if inner.get("text") == "hello-from-task":
+                        got.set()
+
+            c.subscribe_topic("print", on_print)
+            await c.register_plugin(ForwardOutput())
+            try:
+                def shout(x):
+                    print("hello-from-task")
+                    return x
+
+                assert await c.submit(shout, 1).result() == 1
+                await asyncio.wait_for(got.wait(), 30)
+                assert any(
+                    m["text"] == "hello-from-task"
+                    and m["stream"] == "stdout" for m in seen
+                )
+            finally:
+                # restore process-global streams before other tests run
+                await c.unregister_worker_plugin("forward-output")
+                import sys as _sys
+
+                assert not hasattr(_sys.stdout, "_inner")
