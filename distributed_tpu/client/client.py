@@ -132,6 +132,15 @@ class Future:
     def __repr__(self) -> str:
         return f"<Future: {self.status}, key: {self.key}>"
 
+    def _repr_html_(self) -> str:
+        color = {
+            "finished": "green", "error": "red", "cancelled": "gray"
+        }.get(self.status, "orange")
+        return (
+            f"<b>Future:</b> <tt>{self.key}</tt> "
+            f"<b style='color:{color}'>{self.status}</b>"
+        )
+
     def __getstate__(self) -> str:
         # futures pickle as their key alone (reference client.py:430);
         # the receiving side rebinds to its own client (_rebind_futures)
@@ -190,6 +199,7 @@ class Client:
         self._pubsub_subs: dict[str, list] = {}
         self._event_handlers: dict[str, list] = {}
         self._worker_rpcs: dict[str, Any] = {}
+        self._scheduler_identity: dict = {}  # last identity() snapshot
         self._generation = 0
         self.loop: asyncio.AbstractEventLoop | None = None
         self._loop_runner: LoopRunner | None = None
@@ -231,6 +241,12 @@ class Client:
         )
         self._handle_report_task = asyncio.create_task(self._handle_report())
         self.status = "running"
+        try:
+            # one identity snapshot at connect so _repr_html_ (sync, must
+            # not block) has workers/dashboard to show immediately
+            await self.scheduler_info()
+        except Exception:  # pragma: no cover - scheduler racing shutdown
+            pass
         logger.info("%s connected to %s", self.id, self.address)
         return self
 
@@ -1073,7 +1089,8 @@ class Client:
 
     async def scheduler_info(self) -> dict:
         assert self.scheduler is not None
-        return await self.scheduler.identity()
+        self._scheduler_identity = await self.scheduler.identity()
+        return self._scheduler_identity
 
     async def wait_for_workers(
         self, n_workers: int, timeout: float | None = None
@@ -1105,6 +1122,44 @@ class Client:
 
     def __repr__(self) -> str:
         return f"<Client {self.id!r} {self.status} scheduler={self.address!r}>"
+
+    def _repr_html_(self) -> str:
+        """Notebook widget (the reference's jinja2 ``widgets/`` role):
+        connection summary plus the worker/thread/memory rollup from the
+        last ``scheduler_info()`` snapshot (repr must not block)."""
+        def format_bytes(n: float) -> str:
+            for unit in ("B", "kiB", "MiB", "GiB", "TiB"):
+                if n < 1024 or unit == "TiB":
+                    return f"{n:.2f} {unit}"
+                n /= 1024
+            return f"{n:.2f} TiB"  # pragma: no cover
+
+        rows = [
+            ("Status", str(self.status)),
+            ("Scheduler", str(self.address)),
+        ]
+        info = self._scheduler_identity or {}
+        workers = info.get("workers", {})
+        if workers:
+            rows.append(("Workers", str(len(workers))))
+            rows.append((
+                "Threads",
+                str(sum(w.get("nthreads", 0) for w in workers.values())),
+            ))
+            mem = sum(w.get("memory_limit") or 0 for w in workers.values())
+            if mem:
+                rows.append(("Memory", format_bytes(mem)))
+        dash = info.get("dashboard")
+        if dash:
+            rows.append(("Dashboard", f'<a href="{dash}">{dash}</a>'))
+        body = "".join(
+            f"<tr><th style='text-align:left'>{k}</th><td>{v}</td></tr>"
+            for k, v in rows
+        )
+        return (
+            f"<h4 style='margin-bottom:0'>Client {self.id}</h4>"
+            f"<table>{body}</table>"
+        )
 
 
 # ------------------------------------------------------------ helpers

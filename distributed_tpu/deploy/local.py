@@ -116,3 +116,23 @@ class LocalCluster:
             f"<LocalCluster {len(self.workers)} workers, "
             f"scheduler={self.scheduler!r}>"
         )
+
+    def _repr_html_(self) -> str:
+        """Notebook widget (reference jinja2 ``widgets/`` role)."""
+        threads = sum(
+            getattr(w, "nthreads", 1) for w in self.workers
+        )
+        dash = getattr(self.scheduler, "dashboard_address", None)
+        link = (
+            f'<tr><th style="text-align:left">Dashboard</th>'
+            f'<td><a href="{dash}">{dash}</a></td></tr>' if dash else ""
+        )
+        return (
+            "<h4 style='margin-bottom:0'>LocalCluster</h4><table>"
+            f"<tr><th style='text-align:left'>Scheduler</th>"
+            f"<td><tt>{self.scheduler_address}</tt></td></tr>"
+            f"<tr><th style='text-align:left'>Workers</th>"
+            f"<td>{len(self.workers)}</td></tr>"
+            f"<tr><th style='text-align:left'>Threads</th>"
+            f"<td>{threads}</td></tr>{link}</table>"
+        )

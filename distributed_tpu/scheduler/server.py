@@ -1541,11 +1541,37 @@ class Scheduler(Server):
             return list(self.state.events.get(topic, ()))
         return {t: list(evs) for t, evs in self.state.events.items()}
 
+    @property
+    def dashboard_address(self) -> str | None:
+        """http://host:port of the live dashboard, None before start.
+
+        The host comes from the scheduler's ADVERTISED address, not the
+        HTTP bind host: the latter defaults to 127.0.0.1, which would
+        hand remote clients a link to their own loopback."""
+        http = getattr(self, "http_server", None)
+        if http is None:
+            return None
+        try:
+            port = http.port
+        except Exception:  # pragma: no cover - server not listening yet
+            return None
+        host = http.host
+        try:
+            from distributed_tpu.comm.addressing import parse_host_port
+
+            adv = parse_host_port(self.address.split("://", 1)[-1])[0]
+            if adv and adv not in ("0.0.0.0", ""):
+                host = adv
+        except Exception:
+            pass  # inproc:// etc: keep the bind host
+        return f"http://{host}:{port}"
+
     async def identity(self) -> dict:
         return {
             "type": type(self).__name__,
             "id": self.id,
             "address": self.address,
+            "dashboard": self.dashboard_address,
             "workers": {
                 addr: {
                     "name": ws.name,

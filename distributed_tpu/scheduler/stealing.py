@@ -85,6 +85,9 @@ class WorkStealing:
         self._kick_pending = False
         self._last_balance = 0.0
         self._rr = 0  # round-robin cursor for dep-free thief choice
+        # off-loop device-plan pipeline (see _balance_device)
+        self._device_plan_inflight = False
+        self._device_executor: Any | None = None
 
         for ws in self.state.workers.values():
             self.add_worker_state(ws)
@@ -102,6 +105,9 @@ class WorkStealing:
 
     async def close(self) -> None:
         self._pc.stop()
+        if self._device_executor is not None:
+            self._device_executor.shutdown(wait=False, cancel_futures=True)
+            self._device_executor = None
 
     # -------------------------------------------------------- plugin hooks
 
@@ -350,9 +356,20 @@ class WorkStealing:
             device_dispatch_worthwhile,
         )
 
+        n_stealable = sum(
+            len(t) for levels in self.stealable.values() for t in levels
+        )
+        if not n_stealable:
+            # nothing to move (e.g. every queued task is homed/pinned —
+            # the shuffle regime): skip both engines outright
+            return
+        if self._device_plan_inflight:
+            # a device plan is being computed off-loop for a snapshot a
+            # few ms old; applying python steals on top would double-move
+            return
         if device_dispatch_worthwhile(
             len(s.workers),
-            sum(len(t) for levels in self.stealable.values() for t in levels),
+            n_stealable,
             self.DEVICE_MIN_TASKS,
             periodic=True,
         ):
@@ -495,13 +512,66 @@ class WorkStealing:
             idle=np.asarray([ws in idle_set for ws in workers], bool),
             running=np.asarray([ws in s.running for ws in workers], bool),
         )
-        thief_of = ops_stealing.plan_steals(batch)
+        # the kernel call (jit compile on first use — >1 s — plus the
+        # dispatch+sync) runs on a daemon thread: a blocking jax call on
+        # the event loop stalls heartbeats and every RPC for its whole
+        # duration (measured dominating a 128-worker shuffle's wall).
+        # The apply step hops back to the loop and re-validates each
+        # move against live state, so staleness of the few-ms-old
+        # snapshot costs only a skipped steal, never a wrong one.
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (sync tests): plan inline
+            self._apply_device_plan(
+                ops_stealing.plan_steals(batch), tasks, workers
+            )
+            return
+        if self._device_executor is None:
+            from distributed_tpu.scheduler.jax_placement import (
+                _DaemonExecutor,
+            )
+
+            self._device_executor = _DaemonExecutor("steal-device")
+        self._device_plan_inflight = True
+        fut = self._device_executor.submit(ops_stealing.plan_steals, batch)
+
+        def _done(f):
+            try:
+                loop.call_soon_threadsafe(
+                    self._device_plan_landed, f, tasks, workers
+                )
+            except RuntimeError:
+                self._device_plan_inflight = False  # loop closed
+
+        fut.add_done_callback(_done)
+
+    def _device_plan_landed(self, fut, tasks: list, workers: list) -> None:
+        self._device_plan_inflight = False
+        try:
+            thief_of = fut.result()
+        except BaseException:
+            if not fut.cancelled():
+                logger.exception(
+                    "device steal plan failed; python path continues"
+                )
+            return
+        self._apply_device_plan(thief_of, tasks, workers)
+
+    def _apply_device_plan(self, thief_of, tasks: list,
+                           workers: list) -> None:
+        s = self.state
         for ts, ti in zip(tasks, thief_of):
             if ti < 0:
                 continue
             thief = workers[int(ti)]
             victim = ts.processing_on
             if victim is None or ts.key in self.in_flight:
+                continue
+            if ts.homed:
+                # pinned home while the plan computed off-loop (shuffle
+                # registration): stealing it now would move its input
+                # partition off the very worker the pin protects
                 continue
             if thief not in s.running:
                 continue

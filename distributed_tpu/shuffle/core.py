@@ -115,6 +115,10 @@ class ShuffleRun:
         )
         self._push_unacked: dict[str, int] = {}
         self._push_sent: defaultdict[str, int] = defaultdict(int)
+        # built once: the spec message rides only the run-opening push
+        # per peer (its worker_for map is O(workers) — at 128 workers,
+        # re-walking it per push measurably dominated message handling)
+        self._spec_msg = spec.to_msg()
         self.bytes_received = 0
         self.transfers_done: set[int] = set()
         self.outputs_served: set[int] = set()
@@ -218,14 +222,20 @@ class ShuffleRun:
         lock = self._push_locks[addr]
         async with lock:
             comm = await self._push_comm(addr)
-            await comm.write({
+            msg = {
                 "op": "shuffle_receive",
                 "id": self.id, "run_id": self.run_id,
-                "spec": self.spec.to_msg(),
                 "shards": Serialize(dict(by_output)),
                 "sender": self.worker.address,
                 "reply": False,
-            })
+            }
+            if not self._push_sent[addr]:
+                # run-opening push on this comm: carry the spec so a
+                # cold receiver can build the run without a scheduler
+                # round trip (in-order delivery per comm guarantees it
+                # arrives first); later pushes stay lean
+                msg["spec"] = self._spec_msg
+            await comm.write(msg)
             self._push_sent[addr] += 1
             self._push_unacked[addr] += 1
             if self._push_unacked[addr] >= self.PUSH_WINDOW:
@@ -466,10 +476,20 @@ class ShuffleWorkerExtension:
                 return _fail("stale")
             if run is None or run.run_id < run_id:
                 # first contact for this (id, run_id): build the run
-                # from the spec riding on the message
-                if spec is None:
-                    return _fail("unknown-run")
-                run = self.get_or_create(ShuffleSpec.from_msg(spec))
+                # from the spec riding on the run-opening push, or — if
+                # this push raced ahead of it (reconnected comm) — from
+                # the scheduler
+                if spec is not None:
+                    run = self.get_or_create(ShuffleSpec.from_msg(spec))
+                else:
+                    try:
+                        run = await self.get_or_create_remote(id)
+                    except Exception:
+                        return _fail("unknown-run")
+                    if run.run_id > run_id:
+                        return _fail("stale")
+                    if run.run_id < run_id:
+                        return _fail("unknown-run")
             await run.receive(unwrap(shards))
         except ShuffleClosedError:
             return _fail("stale")

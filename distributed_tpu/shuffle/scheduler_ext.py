@@ -143,6 +143,29 @@ class ShuffleSchedulerExtension:
         keys.extend(f"{st.id}-unpack-{j}" for j in range(st.npartitions_out))
         return keys
 
+    def _pin_tasks_home(self, st: ShuffleState) -> None:
+        """Exempt this shuffle's tasks from work stealing (``ts.homed``,
+        same flag the partition planner uses).  A transfer splits ITS
+        OWN input partition in place and unpack is restriction-pinned to
+        its output owner: stealing either moves megabytes to save
+        milliseconds, and on top of the locality damage the stealable
+        backlog they create was measured dragging the DEVICE balance
+        kernel into every tick of a 128-worker shuffle (~24% of e2e
+        wall went to deciding not to steal)."""
+        tasks = self.scheduler.state.tasks
+        stealing = getattr(
+            self.scheduler.state, "extensions", {}
+        ).get("stealing")
+        for key in self._task_keys(st):
+            ts = tasks.get(key)
+            if ts is not None:
+                ts.homed = True
+                if stealing is not None:
+                    # already-queued tasks entered stealable before the
+                    # first worker registered this shuffle: purge them,
+                    # or they keep tripping the device-balance gate
+                    stealing.remove_key_from_stealable(ts)
+
     def _closing(self) -> bool:
         return (
             self.scheduler.status.name in ("closing", "closed")
@@ -245,6 +268,8 @@ class ShuffleSchedulerExtension:
             stimulus_id = seq_name("shuffle-restart")
             client_msgs, worker_msgs = state.transitions(recs, stimulus_id)
             self.scheduler.send_all(client_msgs, worker_msgs)
+        # releasing clears ts.homed: re-exempt the new epoch's tasks
+        self._pin_tasks_home(st)
 
     # ----------------------------------------------------------- handlers
 
@@ -262,6 +287,7 @@ class ShuffleSchedulerExtension:
             )
             st.device_owned = device_owned
             st.wants_device = bool(device)
+            self._pin_tasks_home(st)
         if worker:
             st.participants.add(worker)
         return {"status": "OK", "spec": st.to_msg(),

@@ -396,15 +396,22 @@ async def test_performance_report_activity_seconds_spill_workload():
                        "heartbeat_interval": 0.1},
     ) as cluster:
         async with Client(cluster.scheduler_address) as c:
-            chunks = c.map(chunk, range(10), pure=False)
-            # cross-worker combines force gather-dep traffic
+            # pin chunks alternately so every combine is cross-worker by
+            # construction (scheduler load-balance drift under a loaded
+            # box once co-located everything and no gather-dep traffic
+            # ever happened)
+            addrs = [w.address for w in cluster.workers]
+            chunks = [
+                c.submit(chunk, i, pure=False, workers=[addrs[i % 2]])
+                for i in range(10)
+            ]
             outs = [
                 c.submit(combine, a, b, pure=False)
                 for a, b in zip(chunks[:-1], chunks[1:])
             ]
             await asyncio.wait_for(c.gather(outs), 60)
             # let a couple of heartbeats ship the fine-metric deltas
-            deadline = asyncio.get_running_loop().time() + 15
+            deadline = asyncio.get_running_loop().time() + 30
             spans = cluster.scheduler.spans
             def have(context, label):
                 return any(
