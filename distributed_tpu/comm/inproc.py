@@ -99,9 +99,19 @@ class InProc(Comm):
             self._closed = True
             raise CommClosedError("peer closed the comm")
         # Serialize leaves pass by reference; unwrap for parity with
-        # networked comms (reference inproc.py same behavior)
+        # networked comms (reference inproc.py same behavior).
+        # nested_deserialize is copy-on-write, so payloads BELOW the top
+        # level may be the sender's own objects — receivers treat message
+        # contents as read-only (the reference shares leaves the same
+        # way).  The top level is always copied here: handle_stream pops
+        # "op" from each message, and broadcast paths (report, pubsub)
+        # send one dict to many inproc streams.
         if self.deserialize:
             msg = nested_deserialize(msg)
+        if type(msg) is list:
+            msg = [dict(m) if type(m) is dict else m for m in msg]
+        elif type(msg) is dict:
+            msg = dict(msg)
         return msg
 
     async def write(self, msg: Any, on_error: str = "message") -> int:

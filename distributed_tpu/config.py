@@ -123,6 +123,15 @@ defaults: dict[str, Any] = {
         # multi-core workers with sub-100us task storms.
         # (No reference equivalent: dask always offloads, worker.py:2210.)
         "inline-threshold": "0",
+        # issue up to this many EXTRA Executes beyond nthreads for tasks
+        # whose duration estimate is under execute-pipeline-threshold;
+        # the worker runs each such instruction batch as ONE executor
+        # submission (one thread handoff + one completion wakeup per
+        # batch).  Tiny-task storms are wakeup-bound: on the config-2
+        # bench the loop thread burned ~87% of process CPU, much of it
+        # self-pipe/epoll churn from per-task executor round trips.
+        "execute-pipeline": 16,
+        "execute-pipeline-threshold": "5ms",
         "connections": {"outgoing": 50, "incoming": 10},
         "preload": [],
         "preload-argv": [],

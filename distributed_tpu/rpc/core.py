@@ -391,8 +391,8 @@ class Server:
                     try:
                         # stream context (worker=/client= address) fills in
                         # unless the message already carries the field
-                        result = handler(**{**extra, **msg})
-                        if inspect.isawaitable(result):
+                        result = handler(**{**extra, **msg}) if extra else handler(**msg)
+                        if result is not None and inspect.isawaitable(result):
                             await result
                     except Exception:
                         logger.exception("stream handler %r failed", op)

@@ -199,10 +199,12 @@ class TaskState:
         "queueable",
         "homed",
         "_rootish",
+        "_hash",
     )
 
     def __init__(self, key: Key, run_spec: Any, state: str = "released"):
         self.key = key
+        self._hash = hash(key)
         self.run_spec = run_spec
         self.priority: tuple | None = None
         self.state = state
@@ -244,7 +246,7 @@ class TaskState:
         return f"<TaskState {self.key!r} {self.state}>"
 
     def __hash__(self) -> int:
-        return hash(self.key)
+        return self._hash
 
     def __eq__(self, other: object) -> bool:
         return self is other

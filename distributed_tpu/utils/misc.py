@@ -165,7 +165,28 @@ class LoopRunner:
 # -- misc --------------------------------------------------------------------
 
 def key_split(key: str) -> str:
-    """'x-123-abc' -> 'x'; "('x', 0, 1)" -> 'x'.  Reference: dask.utils.key_split."""
+    """'x-123-abc' -> 'x'; "('x', 0, 1)" -> 'x'.  Reference: dask.utils.key_split.
+
+    Cached: prefixes are recomputed for the same key at several points
+    of a task's life (scheduler group, worker metrics, spans) and the
+    string scan is pure."""
+    try:
+        return _key_split_cache[key]
+    except KeyError:
+        pass
+    except TypeError:  # unhashable (lists in composite keys): compute raw
+        return _key_split_uncached(key)
+    out = _key_split_uncached(key)
+    if len(_key_split_cache) >= 65536:
+        _key_split_cache.clear()
+    _key_split_cache[key] = out
+    return out
+
+
+_key_split_cache: dict = {}
+
+
+def _key_split_uncached(key: str) -> str:
     if isinstance(key, bytes):
         key = key.decode()
     if isinstance(key, tuple):

@@ -136,6 +136,10 @@ class Worker(Server):
             resources=resources,
             validate=validate,
             data=data,
+            execute_pipeline=int(config.get("worker.execute-pipeline") or 0),
+            execute_pipeline_threshold=config.parse_timedelta(
+                config.get("worker.execute-pipeline-threshold") or "5ms"
+            ),
         )
         self.data = self.state.data
         # unique prefix per worker: the statistical profiler samples by
@@ -756,6 +760,7 @@ class Worker(Server):
         self._handle_instructions(instructions)
 
     def _handle_instructions(self, instructions: list[Instruction]) -> None:
+        executes: list[Execute] = []
         for inst in instructions:
             if isinstance(inst, SendMessageToScheduler):
                 msg = inst.to_dict()
@@ -768,9 +773,7 @@ class Worker(Server):
                 except CommClosedError:
                     pass
             elif isinstance(inst, Execute):
-                self._start_async_instruction(
-                    self._execute(inst.key, inst.stimulus_id)
-                )
+                executes.append(inst)
             elif isinstance(inst, GatherDep):
                 self._start_async_instruction(
                     self._gather_dep(inst.worker, inst.to_gather,
@@ -782,6 +785,44 @@ class Worker(Server):
                 )
             else:  # pragma: no cover - future instruction types
                 raise TypeError(f"unknown instruction {inst!r}")
+        if not executes:
+            return
+        # Batch gate: coalescing serializes the batch on ONE executor
+        # thread and delays every task-finished event until the whole
+        # batch returns, so it is only a win (one thread handoff + one
+        # completion wakeup total) when each task is known-tiny AND the
+        # executor is single-threaded (where they would serialize
+        # anyway).  _ensure_computing's BASE loop also emits
+        # multi-Execute lists for tasks of any duration — those must
+        # keep the per-task path or an nthreads=4 worker would run its
+        # 4 slots sequentially.
+        batchable: list[Execute] = []
+        state = self.state
+        if state.nthreads == 1 and state.execute_pipeline:
+            thresh = state.execute_pipeline_threshold
+            rest: list[Execute] = []
+            for inst in executes:
+                ts = state.tasks.get(inst.key)
+                if (
+                    ts is not None
+                    and not ts.actor
+                    and 0.0 <= ts.duration < thresh
+                ):
+                    batchable.append(inst)
+                else:
+                    rest.append(inst)
+            if len(batchable) < 2:
+                rest = executes
+                batchable = []
+            executes = rest
+        if batchable:
+            self._start_async_instruction(
+                self._execute_batch([(i.key, i.stimulus_id) for i in batchable])
+            )
+        for inst in executes:
+            self._start_async_instruction(
+                self._execute(inst.key, inst.stimulus_id)
+            )
 
     def _start_async_instruction(self, coro: Any) -> None:
         """Run an instruction coroutine; feed its resulting event back in
@@ -827,8 +868,131 @@ class Worker(Server):
             dur if ema is None else 0.7 * ema + 0.3 * dur
         )
 
+    async def _execute_batch(self, items: list[tuple[Key, str]]) -> None:
+        """Run one instruction batch of tiny sync tasks as a single
+        executor submission.
+
+        The execute-pipeline extension (state_machine._ensure_computing)
+        over-fills slots with tasks whose duration estimate is tiny; all
+        Execute instructions of one batch land here and cost ONE thread
+        handoff and ONE completion wakeup total — the per-task
+        run_in_executor round trip (~36 us serial on the bench box, plus
+        self-pipe/epoll churn on the loop) was the dominant scheduler-
+        side overhead for task storms.  Anything that is not a plain
+        sync function (actors, async tasks, literal data, tasks whose
+        state moved on) falls back to the per-task ``_execute`` path
+        with identical semantics; results feed the state machine as one
+        stimulus batch (one transition drain).
+
+        KEEP IN SYNC with ``_execute``: the state filter, substitute
+        failure event, metering wrappers, and success/reschedule/failure
+        event construction are mirrored there — a change to either path
+        (new event field, exception rule) must land in both."""
+        import contextvars
+        from time import perf_counter as _perf
+
+        from distributed_tpu.utils.misc import key_split
+        from distributed_tpu.worker.context import set_thread_worker
+        from distributed_tpu.worker.metrics import context_meter
+
+        events: list[StateMachineEvent] = []
+        calls: list[tuple] = []
+        for key, sid in items:
+            ts = self.state.tasks.get(key)
+            if ts is None or ts.state not in (
+                "executing", "long-running", "cancelled", "resumed"
+            ):
+                continue
+            rs = ts.run_spec
+            fn = getattr(rs, "fn", None)
+            if fn is None or ts.actor or asyncio.iscoroutinefunction(fn):
+                self._start_async_instruction(self._execute(key, sid))
+                continue
+            prefix = key_split(key)
+            start = time()
+            try:
+                fn, args, kwargs = rs.substitute(self.data)
+            except BaseException as e:  # noqa: B036 - corrupt spec / missing dep
+                e2 = truncate_exception(e)
+                events.append(ExecuteFailureEvent(
+                    stimulus_id=sid, key=key, exception=e2, traceback=None,
+                    exception_text=repr(e2),
+                    traceback_text=format_exception(e),
+                    start=start, stop=time(),
+                ))
+                continue
+
+            def _user_metric(label, value, unit, _sid=ts.span_id, _pre=prefix):
+                self._fine_metric("execute", _sid, _pre, label, unit, value)
+
+            with context_meter.add_callback(_user_metric):
+                ctx = contextvars.copy_context()
+            calls.append((key, sid, ts, prefix, ctx, fn, args, kwargs))
+
+        if calls:
+            def _run_batch():
+                out = []
+                for key, sid, ts, prefix, ctx, fn, args, kwargs in calls:
+                    def _call(fn=fn, args=args, kwargs=kwargs,
+                              _pre=prefix, _key=key):
+                        set_thread_worker(self, _key)
+                        t0 = _perf()
+                        try:
+                            if device_profile.active():
+                                with device_profile.annotate(_key):
+                                    return fn(*args, **kwargs)
+                            return fn(*args, **kwargs)
+                        finally:
+                            self._note_inner_duration(_pre, _perf() - t0)
+
+                    start = time()
+                    try:
+                        value = ctx.run(_call)
+                        out.append((key, sid, ts, "ok", value, start, time()))
+                    except Reschedule:
+                        out.append((key, sid, ts, "resched", None, start, time()))
+                    except BaseException as e:  # noqa: B036 - user code
+                        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                            raise
+                        out.append((
+                            key, sid, ts, "err",
+                            (e, format_exception(e)), start, time(),
+                        ))
+                return out
+
+            results = await asyncio.get_running_loop().run_in_executor(
+                self.executor, _run_batch
+            )
+            for key, sid, ts, kind, value, start, stop in results:
+                if kind == "ok":
+                    self.digest_metric("compute-duration", stop - start)
+                    self._fine_metric(
+                        "execute", ts.span_id, key_split(key), "compute",
+                        "seconds", stop - start,
+                    )
+                    events.append(ExecuteSuccessEvent(
+                        stimulus_id=sid, key=key, value=value,
+                        start=start, stop=stop, nbytes=sizeof(value),
+                        type=type(value).__name__,
+                    ))
+                elif kind == "resched":
+                    events.append(RescheduleEvent(stimulus_id=sid, key=key))
+                else:
+                    e, tb_text = value
+                    e2 = truncate_exception(e)
+                    events.append(ExecuteFailureEvent(
+                        stimulus_id=sid, key=key, exception=e2,
+                        traceback=None, exception_text=repr(e2),
+                        traceback_text=tb_text, start=start, stop=stop,
+                    ))
+        if events:
+            self.handle_stimulus(*events)
+        return None
+
     async def _execute(self, key: Key, stimulus_id: str) -> StateMachineEvent | None:
-        """Run one task (reference worker.py:2210)."""
+        """Run one task (reference worker.py:2210).
+
+        KEEP IN SYNC with ``_execute_batch`` (see its docstring)."""
         ts = self.state.tasks.get(key)
         # "resumed" must run too: if the task was cancelled and re-requested
         # BEFORE this coroutine's first tick (busy loop), bailing out here

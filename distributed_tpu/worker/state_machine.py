@@ -94,10 +94,12 @@ class WTaskState:
         "span_id",
         "annotations",
         "stimulus_id",
+        "_hash",
     )
 
     def __init__(self, key: Key, run_spec: Any = None, priority: tuple = ()):
         self.key = key
+        self._hash = hash(key)
         self.run_spec = run_spec
         self.state = "released"
         self.previous: str | None = None  # for cancelled/resumed
@@ -127,7 +129,7 @@ class WTaskState:
         return f"<WTaskState {self.key!r} {self.state}>"
 
     def __hash__(self) -> int:
-        return hash(self.key)
+        return self._hash
 
 
 # --------------------------------------------------------------------- events
@@ -414,9 +416,20 @@ class WorkerState:
         validate: bool | None = None,
         transfer_incoming_count_limit: int | None = None,
         transfer_message_bytes_limit: int | None = None,
+        execute_pipeline: int = 0,
+        execute_pipeline_threshold: float = 0.005,
     ):
         self.address = address
         self.nthreads = nthreads
+        # issue up to this many EXTRA Executes beyond nthreads for tasks
+        # whose scheduler duration estimate is below the threshold: the
+        # server coalesces one instruction batch of tiny tasks into a
+        # single executor submission (one thread handoff + one loop
+        # wakeup for the whole batch instead of per task).  Unknown
+        # prefixes (duration = UNKNOWN 0.5 s) never pipeline, so a slow
+        # first-of-its-kind task cannot hide behind the gate.
+        self.execute_pipeline = execute_pipeline
+        self.execute_pipeline_threshold = execute_pipeline_threshold
         self.data: dict[Key, Any] = data if data is not None else {}
         self.tasks: dict[Key, WTaskState] = {}
         self.ready: HeapSet[WTaskState] = HeapSet(key=lambda ts: ts.priority)
@@ -1327,6 +1340,24 @@ class WorkerState:
             if ts.state != "ready":
                 continue
             instructions += self._transitions({ts: "executing"}, stimulus_id)
+        if self.execute_pipeline and self.ready:
+            # pipeline extension: tiny tasks queue behind the busy
+            # threads so the server can batch their thread handoffs;
+            # stop at the first non-tiny head (priority order is
+            # preserved — skipping over it would reorder execution)
+            limit = self.nthreads + self.execute_pipeline
+            while self.ready and self._executing_count() < limit:
+                ts = self.ready.peek()
+                if ts.state != "ready":
+                    self.ready.pop()
+                    continue
+                if (
+                    ts.actor
+                    or not (0.0 <= ts.duration < self.execute_pipeline_threshold)
+                ):
+                    break
+                self.ready.pop()
+                instructions += self._transitions({ts: "executing"}, stimulus_id)
         return instructions
 
     def _executing_count(self) -> int:

@@ -71,3 +71,39 @@ def test_server_digest_metric_uses_tdigest():
     sketch = s.digests_tdigest["latency"]
     assert sketch.count() == 4
     assert 0.1 <= sketch.quantile(0.5) <= 0.4
+
+
+def test_digest_buffered_add_flush_on_read():
+    """add() buffers samples (no per-sample FFI); any read flushes."""
+    from distributed_tpu.utils.counter import Digest
+
+    d = Digest(block_on_build=True)
+    for i in range(100):
+        d.add(float(i))
+    assert d.count() == 100
+    assert d.min() == 0.0 and d.max() == 99.0
+    d.add(5.0, weight=3.0)  # weighted path flushes + direct FFI
+    assert d.count() == 103
+
+
+def test_digest_concurrent_add_and_read():
+    """Executor threads add while a reader flushes: no sample lost or
+    double-counted (the flush swap + FFI run under a lock)."""
+    import threading
+
+    from distributed_tpu.utils.counter import Digest
+
+    d = Digest(block_on_build=True)
+    N, T = 20_000, 4
+    def adder():
+        for i in range(N):
+            d.add(float(i % 100))
+    threads = [threading.Thread(target=adder) for _ in range(T)]
+    for t in threads:
+        t.start()
+    # concurrent reads force racing flushes
+    for _ in range(50):
+        d.count()
+    for t in threads:
+        t.join()
+    assert d.count() == N * T

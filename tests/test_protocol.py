@@ -198,3 +198,29 @@ def test_shared_serialized_leaf_many_paths():
         assert str(m["exception"]) == "boom"
     # the shared header must NOT have been polluted with path metadata
     assert "path" not in header and "frame-start" not in header
+
+
+def test_nested_deserialize_cow_and_subclasses():
+    """Copy-on-write: wrapper-free messages return the SAME object;
+    wrappers anywhere (including namedtuples / dict subclasses) unwrap."""
+    from collections import OrderedDict, namedtuple
+
+    from distributed_tpu.protocol.serialize import Serialize, nested_deserialize
+
+    plain = {"op": "compute-task", "who_has": {"a": ["w1"]}, "pri": (1, 2)}
+    assert nested_deserialize(plain) is plain
+
+    msg = {"op": "g", "payload": [Serialize(11), {"x": Serialize(22)}]}
+    out = nested_deserialize(msg)
+    assert out["payload"][0] == 11 and out["payload"][1]["x"] == 22
+    assert isinstance(msg["payload"][0], Serialize)  # original untouched
+
+    Point = namedtuple("Point", ["x", "y"])
+    p = Point(Serialize(1), 2)
+    q = nested_deserialize(p)
+    assert isinstance(q, Point) and q == Point(1, 2)
+    p2 = Point(1, 2)
+    assert nested_deserialize(p2) is p2  # unchanged namedtuple passes through
+
+    od = OrderedDict([("k", Serialize(9))])
+    assert nested_deserialize(od)["k"] == 9

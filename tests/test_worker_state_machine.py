@@ -597,3 +597,41 @@ def test_long_running_task_error_and_steal_refusal(ws):
     assert any(isinstance(i, TaskErredMsg) for i in instrs)
     assert ws.tasks["lr1"].state == "error"
     ws.validate_state()
+
+
+def test_execute_pipeline_gates_on_duration():
+    """The pipeline extension over-fills slots ONLY with tasks whose
+    duration estimate is tiny; unknown (0.5 default) or big estimates
+    and actors stop the pipeline at the queue head (priority order is
+    preserved — nothing is skipped over)."""
+    from distributed_tpu.worker.state_machine import Execute
+
+    ws = WorkerState(nthreads=1, validate=True, execute_pipeline=8,
+                     execute_pipeline_threshold=0.005)
+    # one long task fills the real slot; tiny tasks pipeline behind it
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("big", priority=(0,), duration=1.0),
+        ComputeTaskEvent.dummy("t1", priority=(1,), duration=0.0001),
+        ComputeTaskEvent.dummy("t2", priority=(2,), duration=0.0001),
+        ComputeTaskEvent.dummy("t3", priority=(3,), duration=0.5),  # unknown
+        ComputeTaskEvent.dummy("t4", priority=(4,), duration=0.0001),
+    )
+    executes = [i.key for i in instrs if isinstance(i, Execute)]
+    # big takes the slot, t1/t2 pipeline, t3 (unknown) blocks the rest
+    assert executes == ["big", "t1", "t2"], executes
+    assert ws.tasks["t3"].state == "ready"
+    assert ws.tasks["t4"].state == "ready"
+    ws.validate_state()
+
+
+def test_execute_pipeline_disabled_by_default():
+    from distributed_tpu.worker.state_machine import Execute
+
+    ws = WorkerState(nthreads=1, validate=True)
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("a", priority=(0,), duration=0.0001),
+        ComputeTaskEvent.dummy("b", priority=(1,), duration=0.0001),
+    )
+    executes = [i.key for i in instrs if isinstance(i, Execute)]
+    assert executes == ["a"], executes
+    ws.validate_state()
