@@ -82,6 +82,10 @@ class _Channel:
 
 
 class InProc(Comm):
+    # both endpoints live in this process: connect()/on_connection skip
+    # the handshake message exchange entirely
+    same_process = True
+
     def __init__(self, local_addr: str, peer_addr: str, read_q: _Channel,
                  write_q: _Channel, deserialize: bool = True):
         super().__init__(deserialize=deserialize)

@@ -116,8 +116,10 @@ class ShardsBuffer:
     async def _process(self, id: Any, shards: list) -> None:
         raise NotImplementedError
 
-    async def write(self, data: dict[Any, list]) -> None:
-        """Accept shards; blocks while the limiter is over budget."""
+    async def write(self, data: dict[Any, list]) -> int:
+        """Accept shards; blocks while the limiter is over budget.
+        Returns the booked byte estimate (callers reuse it instead of
+        re-walking the shard structure)."""
         if self._exception is not None:
             raise self._exception
         if self.closed:
@@ -131,7 +133,7 @@ class ShardsBuffer:
             self.shards[id].extend(shards)
             self.sizes[id] += n
         if not total:
-            return
+            return 0
         self.bytes_total += total
         self._done.clear()
         # book BEFORE waking the drainer (its release must never precede
@@ -146,6 +148,7 @@ class ShardsBuffer:
             raise self._exception
         if self.closed:
             raise ShuffleClosedError("buffer closed while writing")
+        return total
 
     async def _drain_loop(self) -> None:
         while True:

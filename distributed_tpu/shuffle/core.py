@@ -272,10 +272,9 @@ class ShuffleRun:
             raise ShuffleClosedError(self.id)
         self.touch()
         data = {int(j): list(tagged) for j, tagged in shards.items()}
-        from distributed_tpu.utils.sizeof import sizeof
-
-        self.bytes_received += sizeof(data)
-        await self.store.write(data)
+        # the store's write sizes every shard for its limiter booking —
+        # reuse that instead of a second full sizeof walk
+        self.bytes_received += await self.store.write(data)
 
     async def barrier(self) -> None:
         """All inputs transferred: route the barrier through the scheduler

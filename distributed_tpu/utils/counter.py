@@ -69,8 +69,15 @@ class Digest:
 
     def add(self, x: float, weight: float = 1.0) -> None:
         if weight == 1.0:
-            self._pending.append(x)
-            if len(self._pending) >= 4096:
+            # append under the lock: a lock-free append could land on a
+            # list a racing flush has already swapped out and fed to the
+            # FFI (sample silently lost).  Uncontended acquire stays in
+            # C and never drops the GIL — the cost being avoided here is
+            # the per-sample ctypes call, not the lock.
+            with self._flush_lock:
+                self._pending.append(x)
+                n = len(self._pending)
+            if n >= 4096:
                 self._flush()
             return
         with self._flush_lock:
@@ -126,35 +133,49 @@ class Digest:
                 self.add(x)
 
     def quantile(self, q: float) -> float:
-        self._flush()
-        if self._handle is not None:
-            return self._lib.tdigest_quantile(self._handle, float(q))
-        data = sorted(self._fallback)
+        # the whole read runs under the lock: native "reads" compact the
+        # centroid buffers first (TDigest::flush sorts/merges), so a
+        # concurrent add_batch on the same handle would race in C++
+        with self._flush_lock:
+            self._flush_locked()
+            if self._handle is not None:
+                return self._lib.tdigest_quantile(self._handle, float(q))
+            data = sorted(self._fallback)
         if not data:
             return float("nan")
         idx = min(len(data) - 1, max(0, int(q * (len(data) - 1))))
         return data[idx]
 
     def count(self) -> float:
-        self._flush()
-        if self._handle is not None:
-            return self._lib.tdigest_count(self._handle)
-        return float(len(self._fallback))
+        with self._flush_lock:
+            self._flush_locked()
+            if self._handle is not None:
+                return self._lib.tdigest_count(self._handle)
+            return float(len(self._fallback))
 
     def min(self) -> float:
-        self._flush()
-        if self._handle is not None:
-            return self._lib.tdigest_min(self._handle)
-        return min(self._fallback) if self._fallback else float("nan")
+        with self._flush_lock:
+            self._flush_locked()
+            if self._handle is not None:
+                return self._lib.tdigest_min(self._handle)
+            return min(self._fallback) if self._fallback else float("nan")
 
     def max(self) -> float:
-        self._flush()
-        if self._handle is not None:
-            return self._lib.tdigest_max(self._handle)
-        return max(self._fallback) if self._fallback else float("nan")
+        with self._flush_lock:
+            self._flush_locked()
+            if self._handle is not None:
+                return self._lib.tdigest_max(self._handle)
+            return max(self._fallback) if self._fallback else float("nan")
 
     def serialize(self) -> bytes:
         """Centroid array as bytes, mergeable on another node."""
+        if self._handle is not None:
+            with self._flush_lock:
+                self._flush_locked()
+                need = self._lib.tdigest_serialize(self._handle, None, 0)
+                buf = (ctypes.c_double * need)()
+                self._lib.tdigest_serialize(self._handle, buf, need)
+                return bytes(bytearray(buf))
         self._flush()
         if self._handle is None:
             import struct
@@ -170,10 +191,7 @@ class Digest:
             weight = len(full) / len(data) if data else 1.0
             return struct.pack(f"<d{len(data) * 2}d", float(len(data)),
                                *sum(([x, weight] for x in data), []))
-        need = self._lib.tdigest_serialize(self._handle, None, 0)
-        buf = (ctypes.c_double * need)()
-        self._lib.tdigest_serialize(self._handle, buf, need)
-        return bytes(bytearray(buf))
+        raise AssertionError("unreachable: native path handled above")
 
     def merge_serialized(self, payload: bytes) -> None:
         n = len(payload) // 8

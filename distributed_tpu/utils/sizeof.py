@@ -10,11 +10,38 @@ from typing import Any
 
 
 @singledispatch
-def sizeof(obj: Any) -> int:
+def _sizeof_dispatch(obj: Any) -> int:
     try:
         return sys.getsizeof(obj)
     except Exception:
         return 64
+
+
+# exact-type memo in front of the singledispatch: the functools wrapper
+# (kwargs plumbing + weakref cache lookup) costs more than most size
+# computations — a 128-worker shuffle made ~440k dispatches per second
+# of wall.  register() clears the memo so late registrations
+# (numpy/jax/arrow lazy plugins, user types) still take effect.
+_exact: dict = {}
+
+
+def sizeof(obj: Any) -> int:
+    typ = type(obj)
+    impl = _exact.get(typ)
+    if impl is None:
+        impl = _exact[typ] = _sizeof_dispatch.dispatch(typ)
+    return impl(obj)
+
+
+def _register(cls, func=None):
+    if func is None:
+        return lambda f: _register(cls, f)
+    _sizeof_dispatch.register(cls, func)
+    _exact.clear()
+    return func
+
+
+sizeof.register = _register  # type: ignore[attr-defined]
 
 
 @sizeof.register(list)

@@ -787,18 +787,18 @@ class Worker(Server):
                 raise TypeError(f"unknown instruction {inst!r}")
         if not executes:
             return
-        # Batch gate: coalescing serializes the batch on ONE executor
-        # thread and delays every task-finished event until the whole
-        # batch returns, so it is only a win (one thread handoff + one
-        # completion wakeup total) when each task is known-tiny AND the
-        # executor is single-threaded (where they would serialize
-        # anyway).  _ensure_computing's BASE loop also emits
-        # multi-Execute lists for tasks of any duration — those must
-        # keep the per-task path or an nthreads=4 worker would run its
-        # 4 slots sequentially.
+        # Batch gate: coalescing serializes a batch on ONE executor
+        # thread and delays every task-finished event until that batch
+        # returns, so only known-tiny tasks batch (the scheduler's
+        # duration estimate; unknown prefixes report 0.5 s and never
+        # qualify).  On multi-thread workers the batchable set is SPLIT
+        # into nthreads chunks — one submission per pool thread — so
+        # parallelism survives while handoffs still amortize.
+        # _ensure_computing's BASE loop also emits multi-Execute lists
+        # for tasks of any duration — those keep the per-task path.
         batchable: list[Execute] = []
         state = self.state
-        if state.nthreads == 1 and state.execute_pipeline:
+        if state.execute_pipeline:
             thresh = state.execute_pipeline_threshold
             rest: list[Execute] = []
             for inst in executes:
@@ -816,9 +816,20 @@ class Worker(Server):
                 batchable = []
             executes = rest
         if batchable:
-            self._start_async_instruction(
-                self._execute_batch([(i.key, i.stimulus_id) for i in batchable])
-            )
+            T = state.nthreads
+            chunk = -(-len(batchable) // T)  # ceil: T contiguous chunks
+            for i in range(0, len(batchable), chunk):
+                part = batchable[i:i + chunk]
+                if len(part) == 1:
+                    self._start_async_instruction(
+                        self._execute(part[0].key, part[0].stimulus_id)
+                    )
+                else:
+                    self._start_async_instruction(
+                        self._execute_batch(
+                            [(p.key, p.stimulus_id) for p in part]
+                        )
+                    )
         for inst in executes:
             self._start_async_instruction(
                 self._execute(inst.key, inst.stimulus_id)

@@ -1342,9 +1342,10 @@ class WorkerState:
             instructions += self._transitions({ts: "executing"}, stimulus_id)
         if self.execute_pipeline and self.ready:
             # pipeline extension: tiny tasks queue behind the busy
-            # threads so the server can batch their thread handoffs;
-            # stop at the first non-tiny head (priority order is
-            # preserved — skipping over it would reorder execution)
+            # threads so the server can batch their thread handoffs
+            # (split across the pool on multi-thread workers); stop at
+            # the first non-tiny head (priority order is preserved —
+            # skipping over it would reorder execution)
             limit = self.nthreads + self.execute_pipeline
             while self.ready and self._executing_count() < limit:
                 ts = self.ready.peek()
