@@ -304,6 +304,27 @@ class ForwardLoggingPlugin(WorkerPlugin):
             logging.getLogger(self.logger_name).removeHandler(self._handler)
 
 
+async def _run_install(argv: list[str], what: str) -> None:
+    """Shared soft-failing installer body for PipInstall/CondaInstall:
+    the reference keeps a worker whose environment update failed alive
+    and serving (plugin.py:637,548) — so a nonzero exit OR a missing
+    installer binary logs and returns."""
+    import subprocess
+
+    try:
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: subprocess.run(argv, capture_output=True),
+        )
+    except OSError as e:  # installer binary absent
+        logger.error("%s install failed: %r", what, e)
+        return
+    if proc.returncode != 0:
+        logger.error(
+            "%s install failed: %s", what, proc.stderr.decode()[-1000:]
+        )
+
+
 class PipInstall(WorkerPlugin):
     """pip-install packages on every worker (reference plugin.py:637)."""
 
@@ -320,18 +341,36 @@ class PipInstall(WorkerPlugin):
         self.pip_options = list(pip_options or [])
 
     async def setup(self, worker: Any) -> None:
-        import subprocess
-
-        proc = await asyncio.get_running_loop().run_in_executor(
-            None,
-            lambda: subprocess.run(
-                [sys.executable, "-m", "pip", "install", *self.pip_options,
-                 *self.packages],
-                capture_output=True,
-            ),
+        await _run_install(
+            [sys.executable, "-m", "pip", "install", *self.pip_options,
+             *self.packages],
+            "pip",
         )
-        if proc.returncode != 0:
-            logger.error("pip install failed: %s", proc.stderr.decode()[-1000:])
+
+
+class CondaInstall(WorkerPlugin):
+    """conda-install packages on every worker (reference plugin.py:548).
+
+    Same shape as PipInstall; uses ``conda install --yes`` (or mamba
+    when ``use_mamba``).  Fails soft with a logged error, like the
+    reference: a worker whose environment update failed keeps serving.
+    """
+
+    name = "conda-install"
+
+    def __init__(self, packages: list[str],
+                 conda_options: list[str] | None = None,
+                 use_mamba: bool = False):
+        self.packages = list(packages)
+        self.conda_options = list(conda_options or [])
+        self.use_mamba = use_mamba
+
+    async def setup(self, worker: Any) -> None:
+        exe = "mamba" if self.use_mamba else "conda"
+        await _run_install(
+            [exe, "install", "--yes", *self.conda_options, *self.packages],
+            exe,
+        )
 
 
 class KillWorker(WorkerPlugin):

@@ -228,6 +228,7 @@ def json_api_routes(scheduler: Any) -> dict[str, Callable]:
         "/api/v1/fine_metrics": fine_metrics,
         "/api/v1/profile": profile,
         "/api/v1/graph": graph,
+        "/api/v1/group_timing": lambda: scheduler.group_timing.collect(),
         "/dashboard": lambda: (DASHBOARD_HTML, "text/html; charset=utf-8"),
     }
 

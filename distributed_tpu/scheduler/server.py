@@ -188,6 +188,12 @@ class Scheduler(Server):
         from distributed_tpu.diagnostics.task_stream import TaskStreamPlugin
 
         self.task_stream = TaskStreamPlugin(self)
+        from distributed_tpu.diagnostics.group_timing import GroupTimingPlugin
+
+        self.group_timing = GroupTimingPlugin(self)
+        self.handlers["get_group_timing"] = (
+            lambda **kw: self.group_timing.collect()
+        )
         self.spans = SpansSchedulerExtension(self)
         self._topic_subscribers: dict[str, set[str]] = {}
         self.state.events_subscriber_hook = self._fan_out_event
@@ -202,6 +208,8 @@ class Scheduler(Server):
             lambda **kw: memory_sample_handler(self, **kw)
         )
         self.handlers["get_profile"] = self.get_profile
+        self.handlers["eventstream_start"] = self.eventstream_start
+        self.handlers["eventstream_stop"] = self.eventstream_stop
         self.stream_handlers["subscribe-topic"] = self.subscribe_topic
         self.stream_handlers["unsubscribe-topic"] = self.unsubscribe_topic
         self.stream_handlers["log-event-client"] = self.handle_client_log_event
@@ -1566,7 +1574,32 @@ class Scheduler(Server):
             pass  # inproc:// etc: keep the bind host
         return f"http://{host}:{port}"
 
+    def eventstream_start(self) -> str:
+        """Install the opt-in per-task event publisher (reference
+        diagnostics/eventstream.py:12); consumers subscribe to the
+        returned topic.  Opt-in because it costs a ring-buffer append
+        plus subscriber fan-out on EVERY task completion.  Refcounted:
+        the plugin is global, so one consumer's stop must not kill the
+        stream for the others."""
+        from distributed_tpu.diagnostics.eventstream import EventStreamPlugin
+
+        self._eventstream_refs = getattr(self, "_eventstream_refs", 0) + 1
+        if EventStreamPlugin.name not in self.state.plugins:
+            EventStreamPlugin(self)
+        return EventStreamPlugin.topic
+
+    def eventstream_stop(self) -> None:
+        from distributed_tpu.diagnostics.eventstream import EventStreamPlugin
+
+        self._eventstream_refs = max(
+            getattr(self, "_eventstream_refs", 0) - 1, 0
+        )
+        if not self._eventstream_refs:
+            self.state.plugins.pop(EventStreamPlugin.name, None)
+
     async def identity(self) -> dict:
+        """Cluster snapshot; shape documented by
+        ``utils.objects.SchedulerInfo`` (reference objects.py)."""
         return {
             "type": type(self).__name__,
             "id": self.id,

@@ -211,6 +211,29 @@ def _ishex(s: str) -> bool:
     return all(c in "0123456789abcdef" for c in s)
 
 
+class RateLimiterFilter:
+    """Logging filter dropping repeats of matching records within a
+    window (reference utils.py RateLimiterFilter).  Attach to loggers
+    that can storm — per-comm connection failures during a netsplit
+    would otherwise emit thousands of identical lines."""
+
+    def __init__(self, pattern: str, rate: float = 10.0):
+        import re
+
+        self._pattern = re.compile(pattern)
+        self.rate = rate  # seconds between emissions
+        self._last = 0.0
+
+    def filter(self, record) -> bool:
+        if not self._pattern.search(record.getMessage()):
+            return True
+        now = time()
+        if now - self._last >= self.rate:
+            self._last = now
+            return True
+        return False
+
+
 def funcname(func: Any) -> str:
     while hasattr(func, "func"):
         func = func.func

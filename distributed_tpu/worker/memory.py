@@ -26,6 +26,12 @@ if TYPE_CHECKING:
     from distributed_tpu.worker.server import Worker
 
 logger = logging.getLogger("distributed_tpu.worker.memory")
+# the monitor re-evaluates every 100 ms: without a limiter a worker
+# camped over the spill threshold logs the same line 10x/s
+# (reference utils.py RateLimiterFilter, applied the same way)
+from distributed_tpu.utils.misc import RateLimiterFilter  # noqa: E402
+
+logger.addFilter(RateLimiterFilter(r"> spill threshold", rate=10.0))
 
 
 def _process_rss() -> int:

@@ -533,3 +533,59 @@ async def test_device_profile_roundtrip():
             # stop without a trace running errors cleanly
             idle = await c.device_profile_stop()
             assert all(r["status"] == "error" for r in idle.values())
+
+
+@gen_test()
+async def test_group_timing_buckets():
+    """GroupTiming (reference progress.py:344 role): compute seconds
+    aggregate into wall-clock buckets per prefix."""
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            import time as _t
+
+            def work(x):
+                _t.sleep(0.05)
+                return x
+
+            futs = [c.submit(work, i, key=f"gt-{i}") for i in range(6)]
+            await c.gather(futs)
+            data = await c.scheduler.get_group_timing()
+            assert data["bucket_s"] > 0
+            assert "gt" in data["series"], data["series"].keys()
+            total = sum(data["series"]["gt"])
+            assert 0.2 < total < 3.0, total  # ~6 x 50ms of compute
+
+
+@gen_test()
+async def test_eventstream_topic():
+    """Opt-in eventstream publishes per-task events on a topic
+    (reference diagnostics/eventstream.py role)."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            topic = await c.scheduler.eventstream_start()
+            assert topic == "task-events"
+            await c.submit(lambda: 41, key="ev-1").result()
+            events = await c.get_events(topic)
+            acts = [m.get("action") for _, m in events]
+            assert "task-finished" in acts, events
+            keys = [m.get("key") for _, m in events]
+            assert "ev-1" in keys
+            await c.scheduler.eventstream_stop()
+            n = len(await c.get_events(topic))
+            await c.submit(lambda: 42, key="ev-2").result()
+            assert len(await c.get_events(topic)) == n  # stopped
+
+
+def test_rate_limiter_filter():
+    import logging
+
+    from distributed_tpu.utils.misc import RateLimiterFilter
+
+    f = RateLimiterFilter("spammy", rate=60.0)
+    rec = logging.LogRecord("test-rlf", logging.INFO, "f", 1,
+                            "spammy message", (), None)
+    other = logging.LogRecord("test-rlf", logging.INFO, "f", 1,
+                              "normal message", (), None)
+    assert f.filter(rec) is True      # first passes
+    assert f.filter(rec) is False     # repeat suppressed
+    assert f.filter(other) is True    # non-matching always passes
