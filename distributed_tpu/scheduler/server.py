@@ -210,6 +210,7 @@ class Scheduler(Server):
         self.handlers["get_profile"] = self.get_profile
         self.handlers["eventstream_start"] = self.eventstream_start
         self.handlers["eventstream_stop"] = self.eventstream_stop
+        self.handlers["get_computations"] = self.get_computations
         self.stream_handlers["subscribe-topic"] = self.subscribe_topic
         self.stream_handlers["unsubscribe-topic"] = self.unsubscribe_topic
         self.stream_handlers["log-event-client"] = self.handle_client_log_event
@@ -452,7 +453,8 @@ class Scheduler(Server):
 
     async def heartbeat_worker(
         self, address: str = "", now: float = 0.0, metrics: dict | None = None,
-        fine_metrics: list | None = None, **kwargs: Any,
+        fine_metrics: list | None = None, executing_status: str = "",
+        **kwargs: Any,
     ) -> dict:
         ws = self.state.workers.get(address)
         if ws is None:
@@ -463,6 +465,23 @@ class Scheduler(Server):
             ws.metrics = metrics
         if fine_metrics and self.spans is not None:
             self.spans.collect_fine_metrics(fine_metrics)
+        # reconcile pause state: the event message can be lost at
+        # startup (see Worker.heartbeat) and a stale "running" view
+        # pins the paused worker's tasks out of stealing forever.
+        # A heartbeat that raced a fresher stream-delivered change must
+        # NOT win (its snapshot predates the RPC; the spurious paused
+        # flip un-homes tasks irreversibly): a recent stream change
+        # suppresses reconciliation — a REAL persistent mismatch is
+        # re-reported by the next heartbeat once the window passes.
+        if (
+            executing_status
+            and executing_status != ws.status
+            and time() - ws.status_changed_at > 1.0
+        ):
+            self.handle_worker_status_change(
+                status=executing_status, worker=address,
+                stimulus_id=seq_name("heartbeat-status"),
+            )
         return {"status": "OK", "time": time(),
                 "heartbeat-interval": self.heartbeat_interval()}
 
@@ -751,6 +770,7 @@ class Scheduler(Server):
         if ws is None:
             return
         ws.status = status
+        ws.status_changed_at = time()
         if status == "paused":
             self.state.running.discard(ws)
             self.state.idle.pop(ws.address, None)
@@ -1573,6 +1593,20 @@ class Scheduler(Server):
         except Exception:
             pass  # inproc:// etc: keep the bind host
         return f"http://{host}:{port}"
+
+    def get_computations(self) -> list[dict]:
+        """Recent update_graph batches, newest last (reference
+        Scheduler.computations, scheduler.py:864)."""
+        return [
+            {
+                "id": comp.id,
+                "start": comp.start,
+                "stop": comp.stop,
+                "groups": sorted(tg.name for tg in comp.groups),
+                "states": comp.states,
+            }
+            for comp in self.state.computations
+        ]
 
     def eventstream_start(self) -> str:
         """Install the opt-in per-task event publisher (reference

@@ -110,6 +110,41 @@ class TaskPrefix:
         return f"<TaskPrefix {self.name!r}>"
 
 
+class Computation:
+    """One batch of submitted graphs, for diagnostics
+    (reference scheduler.py:864): groups the TaskGroups born in one
+    ``update_graph`` so dashboards and dumps can slice cluster activity
+    by submission instead of by prefix."""
+
+    __slots__ = ("start", "groups", "code", "id")
+
+    def __init__(self):
+        from distributed_tpu.utils.misc import seq_name
+
+        self.start = time()
+        self.groups: set[TaskGroup] = set()
+        self.code: list[str] = []
+        self.id = seq_name("computation")
+
+    @property
+    def stop(self) -> float:
+        return max((tg.stop for tg in self.groups), default=0.0)
+
+    @property
+    def states(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for tg in self.groups:
+            for st, n in tg.states.items():
+                out[st] = out.get(st, 0) + n
+        return out
+
+    def __repr__(self) -> str:
+        return (
+            f"<Computation {self.id}: {len(self.groups)} groups, "
+            f"{sum(self.states.values())} tasks>"
+        )
+
+
 class TaskGroup:
     """Statistics per key-group; unit of root-ish detection
     (reference scheduler.py:1033)."""
@@ -311,6 +346,7 @@ class WorkerState:
         "occupancy",
         "_network_occ",
         "last_seen",
+        "status_changed_at",
         "metrics",
         "memory_unmanaged_old",
         "bandwidth",
@@ -345,6 +381,7 @@ class WorkerState:
         self.occupancy = 0.0
         self._network_occ = 0  # bytes pending transfer to this worker
         self.last_seen = time()
+        self.status_changed_at = 0.0  # last stream-delivered status flip
         self.metrics: dict = {}
         self.memory_unmanaged_old = 0
         self.bandwidth = float(config.get("scheduler.bandwidth"))
@@ -383,6 +420,8 @@ class SchedulerState:
     ):
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
+        # one entry per update_graph batch (reference scheduler.py:864)
+        self.computations: deque[Computation] = deque(maxlen=100)
         self.task_prefixes: dict[str, TaskPrefix] = {}
         self.workers: dict[str, WorkerState] = {}
         self.aliases: dict[object, str] = {}  # name -> address
@@ -2098,6 +2137,14 @@ class SchedulerState:
             }
             priorities = {k: (r,) for k, r in order_fn(pruned).items()}
 
+        # reuse a trailing EMPTY computation: dependency-only or
+        # already-known-key submissions must not flush real history out
+        # of the bounded deque
+        if self.computations and not self.computations[-1].groups:
+            computation = self.computations[-1]
+        else:
+            computation = Computation()
+            self.computations.append(computation)
         touched: list[TaskState] = []
         for key, spec in tasks.items():
             ts = self.tasks.get(key)
@@ -2105,6 +2152,8 @@ class SchedulerState:
                 ts = self.new_task(key, spec, "released")
             elif ts.run_spec is None and spec is not None:
                 ts.run_spec = spec
+            if ts.group is not None and ts.run_spec is not None:
+                computation.groups.add(ts.group)
             touched.append(ts)
 
         for key, deps in dependencies.items():

@@ -397,6 +397,14 @@ class Worker(Server):
                 now=time(),
                 metrics=self.metrics(),
                 fine_metrics=self.fine_metrics.rows(delta),
+                # paused/running travels with every heartbeat: the
+                # event-driven worker-status-change message is lossy at
+                # the edges (a pause during startup fires before the
+                # batched stream exists and is swallowed), and a
+                # scheduler that thinks a paused worker is running never
+                # frees its tasks for stealing
+                executing_status="paused" if not self.state.running
+                else "running",
             )
             if resp.get("status") == "missing":
                 # scheduler forgot us (e.g. after its restart): re-register

@@ -396,3 +396,103 @@ async def test_reschedule_exception_reruns_task():
             fut = c.submit(flaky, pure=False)
             assert await asyncio.wait_for(fut.result(), 30) == 42
             assert attempts.value >= 2
+
+
+@gen_test()
+async def test_worker_ttl_evicts_silent_worker_and_recomputes():
+    """A worker whose heartbeats stop is evicted after worker-ttl and
+    its unique data recomputes by lineage (reference scheduler.py:8312,
+    worker-ttl: 5 minutes scaled down here)."""
+    async with await new_cluster(
+        n_workers=2,
+        scheduler_kwargs={"worker_ttl": 0.6},
+        worker_kwargs={"heartbeat_interval": 0.1},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            fut = c.submit(lambda: 123, key="ttl-x")
+            assert await fut.result() == 123
+            holder_addr = next(iter(
+                ws.address
+                for ws in cluster.scheduler.state.tasks["ttl-x"].who_has
+            ))
+            victim = next(
+                w for w in cluster.workers if w.address == holder_addr
+            )
+            # silence the victim: stop its heartbeat callback (the
+            # process stays up — this is a network-partition shape, the
+            # one failure only ttl catches)
+            victim.periodic_callbacks["heartbeat"].stop()
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if holder_addr not in cluster.scheduler.state.workers:
+                    break
+            else:
+                raise AssertionError("silent worker never evicted by ttl")
+            # the future's data died with the worker: a fresh gather
+            # recomputes it from run_spec on the survivor
+            assert await c.submit(
+                lambda v: v + 1, fut, key="ttl-y"
+            ).result() == 124
+
+
+@gen_test()
+async def test_wait_for_workers():
+    """Client.wait_for_workers blocks until the cluster reaches the
+    requested size (reference client.py wait_for_workers)."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await asyncio.wait_for(c.wait_for_workers(1), 10)
+            from distributed_tpu.worker.server import Worker
+
+            async def join_later():
+                await asyncio.sleep(0.3)
+                w = Worker(
+                    cluster.scheduler_address, nthreads=1, validate=True
+                )
+                await w.start()
+                return w
+
+            task = asyncio.ensure_future(join_later())
+            t0 = asyncio.get_running_loop().time()
+            await asyncio.wait_for(c.wait_for_workers(2), 15)
+            assert asyncio.get_running_loop().time() - t0 >= 0.2
+            w = await task
+            await w.close()
+
+
+@gen_test(timeout=120)
+async def test_paused_at_startup_reconciled_via_heartbeat():
+    """A pause that fires before the batched stream exists is lost as
+    an event; the heartbeat's executing_status reconciles the
+    scheduler's view so the paused worker's tasks free for stealing."""
+    async with await new_cluster(
+        n_workers=2,
+        worker_kwargs={"heartbeat_interval": 0.1},
+    ) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            victim = cluster.workers[0]
+            # pause silently: flip the state machine without telling
+            # the scheduler (the lost-message shape)
+            from distributed_tpu.utils.misc import seq_name
+            from distributed_tpu.worker.state_machine import PauseEvent
+
+            victim.handle_stimulus(
+                PauseEvent(stimulus_id=seq_name("test-pause"))
+            )
+            sws = cluster.scheduler.state.workers[victim.address]
+            assert sws.status != "paused"  # scheduler doesn't know yet
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if sws.status == "paused":
+                    break
+            else:
+                raise AssertionError(
+                    "heartbeat never reconciled the paused status"
+                )
+            # work avoids the paused worker: all tasks land and finish
+            # on the survivor despite round-robin's best efforts
+            futs = [c.submit(lambda x: x + 1, i, key=f"hb-{i}")
+                    for i in range(12)]
+            assert await asyncio.wait_for(c.gather(futs), 60) == [
+                i + 1 for i in range(12)
+            ]

@@ -381,6 +381,19 @@ async def test_performance_report_activity_seconds_spill_workload():
     a spill-heavy workload's performance report carries per-activity
     seconds — spill serialize/disk-write/disk-read plus the gather-dep
     network/deserialize/other split from the DelayedMetricsLedger."""
+    from distributed_tpu import config as dtpu_config
+
+    # pause OFF: a 4 MB memory_limit makes the process-RSS fraction
+    # permanently exceed the pause threshold, so on a slow box the
+    # 100 ms monitor tick can fire mid-workload and pause both workers
+    # FOREVER (nothing ever brings rss under 4 MB) — observed as a 60 s
+    # gather timeout.  This test is about spill metering, which keys on
+    # managed (fast_bytes) memory and still engages.
+    with dtpu_config.set({"worker.memory.pause": 0}):
+        await _spill_workload_body()
+
+
+async def _spill_workload_body():
     import numpy as np
 
     def chunk(i):
@@ -589,3 +602,25 @@ def test_rate_limiter_filter():
     assert f.filter(rec) is True      # first passes
     assert f.filter(rec) is False     # repeat suppressed
     assert f.filter(other) is True    # non-matching always passes
+
+
+@gen_test()
+async def test_computations_track_submissions():
+    """Computation objects group each update_graph batch
+    (reference scheduler.py:864)."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.gather([c.submit(lambda x: x, i, key=f"ca-{i}")
+                            for i in range(3)])
+            await c.gather([c.submit(lambda x: -x, i, key=f"cb-{i}")
+                            for i in range(2)])
+            comps = await c.scheduler.get_computations()
+            assert len(comps) >= 2
+            names = [set(co["groups"]) for co in comps]
+            assert any("ca" in ns for ns in names)
+            assert any("cb" in ns for ns in names)
+            last = comps[-1]
+            assert last["states"].get("memory", 0) + last["states"].get(
+                "forgotten", 0
+            ) > 0
+            assert last["stop"] >= last["start"] or last["stop"] == 0.0
