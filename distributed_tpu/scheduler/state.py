@@ -116,14 +116,13 @@ class Computation:
     ``update_graph`` so dashboards and dumps can slice cluster activity
     by submission instead of by prefix."""
 
-    __slots__ = ("start", "groups", "code", "id")
+    __slots__ = ("start", "groups", "id")
 
     def __init__(self):
         from distributed_tpu.utils.misc import seq_name
 
         self.start = time()
         self.groups: set[TaskGroup] = set()
-        self.code: list[str] = []
         self.id = seq_name("computation")
 
     @property
@@ -2148,11 +2147,18 @@ class SchedulerState:
         touched: list[TaskState] = []
         for key, spec in tasks.items():
             ts = self.tasks.get(key)
+            fresh = False
             if ts is None:
                 ts = self.new_task(key, spec, "released")
+                fresh = spec is not None
             elif ts.run_spec is None and spec is not None:
                 ts.run_spec = spec
-            if ts.group is not None and ts.run_spec is not None:
+                fresh = True
+            # only NEWLY runnable tasks attribute their group here: a
+            # resubmission of known keys must not clone old groups into
+            # a fresh Computation (it would both duplicate history and
+            # flush the bounded deque)
+            if fresh and ts.group is not None:
                 computation.groups.add(ts.group)
             touched.append(ts)
 

@@ -624,3 +624,36 @@ async def test_computations_track_submissions():
                 "forgotten", 0
             ) > 0
             assert last["stop"] >= last["start"] or last["stop"] == 0.0
+
+
+@gen_test()
+async def test_computations_resubmission_does_not_duplicate():
+    """Resubmitting known keys neither re-attributes old groups to a
+    fresh Computation nor floods the bounded history deque."""
+    from distributed_tpu.graph.spec import Graph, TaskSpec
+
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            def build():
+                g = Graph()
+                for i in range(3):
+                    g.tasks[f"rs-{i}"] = TaskSpec(lambda: 7)
+                return g
+
+            outs = [f"rs-{i}" for i in range(3)]
+            futs = c.compute_graph(build(), outs)
+            await c.gather([futs[k] for k in outs])
+            comps = cluster.scheduler.state.computations
+            assert sum(1 for co in comps if co.groups) == 1
+            n0 = len(comps)
+            # resubmit the SAME graph repeatedly (keys known, futures
+            # held): no group may be re-attributed, and the bounded
+            # history must not grow beyond one trailing empty entry
+            for _ in range(5):
+                futs2 = c.compute_graph(build(), outs)
+                await c.gather([futs2[k] for k in outs])
+            attributed = sum(1 for co in comps if co.groups)
+            assert attributed == 1, [
+                (co.id, sorted(tg.name for tg in co.groups)) for co in comps
+            ]
+            assert len(comps) <= n0 + 1  # at most one trailing empty

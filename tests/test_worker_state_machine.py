@@ -635,3 +635,81 @@ def test_execute_pipeline_disabled_by_default():
     executes = [i.key for i in instrs if isinstance(i, Execute)]
     assert executes == ["a"], executes
     ws.validate_state()
+
+
+def test_pipelined_task_steal_refused_and_cancel_discards():
+    """Edge cases of the execute-pipeline extension: a pipelined task is
+    in 'executing' (queued in the thread) so a steal request is refused
+    like a running task; a free-keys for it routes through cancelled and
+    its eventual ExecuteSuccess is discarded, not stored."""
+    from distributed_tpu.worker.state_machine import (
+        Execute,
+        ExecuteSuccessEvent,
+    )
+
+    ws = WorkerState(nthreads=1, validate=True, execute_pipeline=8,
+                     execute_pipeline_threshold=0.005)
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("p1", priority=(0,), duration=0.0001),
+        ComputeTaskEvent.dummy("p2", priority=(1,), duration=0.0001),
+        ComputeTaskEvent.dummy("p3", priority=(2,), duration=0.0001),
+    )
+    executes = [i.key for i in instrs if isinstance(i, Execute)]
+    assert executes == ["p1", "p2", "p3"]
+
+    # steal request against the PIPELINED (not yet running) p3: refused
+    # with its live state, exactly like a truly-executing task
+    instrs = ws.handle_stimulus(StealRequestEvent(stimulus_id="s", key="p3"))
+    resp = [i for i in instrs if isinstance(i, StealResponseMsg)]
+    assert resp and resp[0].state == "executing"
+
+    # scheduler frees p2 while the batch is in flight
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="free", keys=("p2",)))
+    assert ws.tasks["p2"].state == "cancelled"
+
+    # batch completes: p1 stored; p2's result discarded (stays out of
+    # data); p3 stored
+    for key in ("p1", "p2", "p3"):
+        ws.handle_stimulus(ExecuteSuccessEvent(
+            stimulus_id="done", key=key, value=42, start=0.0, stop=0.001,
+            nbytes=28, type="int",
+        ))
+    assert "p1" in ws.data and "p3" in ws.data
+    assert "p2" not in ws.data
+    assert ws.tasks.get("p2") is None or ws.tasks["p2"].state in (
+        "released", "forgotten"
+    )
+    ws.validate_state()
+
+
+def test_pipeline_respects_priority_order():
+    """Pipelined Executes are issued strictly in priority order; a
+    higher-priority arrival AFTER the batch was issued waits for the
+    next slot opening but is not overtaken by later tiny tasks."""
+    from distributed_tpu.worker.state_machine import (
+        Execute,
+        ExecuteSuccessEvent,
+    )
+
+    ws = WorkerState(nthreads=1, validate=True, execute_pipeline=2,
+                     execute_pipeline_threshold=0.005)
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("a", priority=(5,), duration=0.0001),
+        ComputeTaskEvent.dummy("b", priority=(6,), duration=0.0001),
+        ComputeTaskEvent.dummy("c", priority=(7,), duration=0.0001),
+        ComputeTaskEvent.dummy("d", priority=(8,), duration=0.0001),
+    )
+    first = [i.key for i in instrs if isinstance(i, Execute)]
+    assert first == ["a", "b", "c"]  # 1 slot + pipeline depth 2
+    # urgent task arrives while the batch runs
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy("urgent", priority=(0,), duration=0.0001)
+    )
+    assert not [i for i in instrs if isinstance(i, Execute)]  # full
+    instrs = ws.handle_stimulus(ExecuteSuccessEvent(
+        stimulus_id="d1", key="a", value=1, start=0.0, stop=0.001,
+        nbytes=28, type="int",
+    ))
+    nxt = [i.key for i in instrs if isinstance(i, Execute)]
+    assert nxt == ["urgent"], nxt  # beats d despite arriving later
+    ws.validate_state()
