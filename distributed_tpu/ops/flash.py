@@ -32,8 +32,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale, causal, block_q, block_k):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, scale, causal, block_q, block_k):
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -85,6 +85,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
         ).astype(o_ref.dtype)
+        # per-row logsumexp: the backward pass recomputes
+        # p = exp(s - lse) from it without re-running the online max
+        lse_ref[0] = (
+            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        )[:, None]
 
 
 @functools.partial(
@@ -109,10 +114,14 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda ih, iq, ik: (ih, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda ih, iq, ik: (ih, ik, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda ih, iq, ik: (ih, iq, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((h, n, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda ih, iq, ik: (ih, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda ih, iq, ik: (ih, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, n, d), q.dtype),
+            jax.ShapeDtypeStruct((h, n, 1), jnp.float32),  # logsumexp
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
             pltpu.VMEM((block_q, 1), jnp.float32),  # running sum-exp
@@ -122,15 +131,87 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Differentiable shell: pallas forward, recompute backward.
+
+    Inputs/outputs are ``[H, N, D]``.  The backward is standard flash
+    (Dao et al.): from the saved logsumexp it recomputes
+    ``p = exp(s - lse)`` q-chunk by q-chunk in plain XLA — O(N * block)
+    memory, never the full [N, N] score matrix — and accumulates
+    dK/dV across chunks with a scan."""
+    out, _lse = _flash_call(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_call(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse[..., 0])
+
+
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res  # q,out: [H,Nq,D]; k,v: [H,Nk,D]; lse: [H,Nq]
+    h, n, d = q.shape
+    nk = k.shape[1]
+    nchunk = n // block_q
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # D_i = rowsum(dO * O): the softmax-normalization term of dS
+    delta = (dof * out.astype(jnp.float32)).sum(-1)  # [H, N]
+
+    def chunk(carry, i):
+        dk, dv = carry
+        qc = lax.dynamic_slice_in_dim(q, i * block_q, block_q, 1)
+        dc = lax.dynamic_slice_in_dim(dof, i * block_q, block_q, 1)
+        lc = lax.dynamic_slice_in_dim(lse, i * block_q, block_q, 1)
+        delc = lax.dynamic_slice_in_dim(delta, i * block_q, block_q, 1)
+        s = jnp.einsum(
+            "hqd,hkd->hqk", qc.astype(jnp.float32), kf
+        ) * scale
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, nk), 0
+            )
+            kpos = lax.broadcasted_iota(jnp.int32, (block_q, nk), 1)
+            s = jnp.where((qpos >= kpos)[None], s, _NEG)
+        p = jnp.exp(s - lc[:, :, None])  # [H, TQ, N]
+        dv_add = jnp.einsum("hqk,hqd->hkd", p, dc)
+        dp = jnp.einsum("hqd,hkd->hqk", dc, vf)
+        ds = p * (dp - delc[:, :, None])
+        dq_c = jnp.einsum("hqk,hkd->hqd", ds, kf) * scale
+        dk_add = jnp.einsum("hqk,hqd->hkd", ds, qc.astype(jnp.float32))
+        return (dk + dk_add * scale, dv + dv_add), dq_c
+
+    (dk, dv), dq_chunks = lax.scan(
+        chunk,
+        (jnp.zeros((h, nk, d), jnp.float32),
+         jnp.zeros((h, nk, d), jnp.float32)),
+        jnp.arange(nchunk),
+    )
+    # scan stacked the chunks on axis 0: [nchunk, H, TQ, D] -> [H, N, D]
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(h, n, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, scale: float | None = None,
     block_q: int = 128, block_k: int = 128, interpret: bool | None = None,
 ):
     """Flash attention over ``[seq, heads, dim]`` inputs on one device.
 
-    Blocks clamp to the sequence length; seq must divide by the (clamped)
-    blocks.  ``interpret`` defaults to True off-TPU so CPU test meshes
-    run the same kernel.
+    Training-grade: ``jax.grad`` flows through (pallas forward + a
+    recompute-based flash backward via custom_vjp).  Blocks clamp to
+    the sequence length; seq must divide by the (clamped) blocks.
+    ``interpret`` defaults to True off-TPU so CPU test meshes run the
+    same kernel.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -147,7 +228,7 @@ def flash_attention(
     qt = jnp.transpose(q, (1, 0, 2))  # [H, N, D]
     kt = jnp.transpose(k, (1, 0, 2))
     vt = jnp.transpose(v, (1, 0, 2))
-    out = _flash_call(
+    out = _flash_diff(
         qt, kt, vt, bool(causal), float(scale), block_q, block_k,
         bool(interpret),
     )

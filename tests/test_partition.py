@@ -174,19 +174,31 @@ def test_live_planner_partitions_and_wins_locality():
                     return served, stats
 
     async def main():
-        # one bounded retry: the margin is normally huge (plan runs cut
+        # bounded retries: the margin is normally huge (plan runs cut
         # transfers ~10x), but a CPU-starved CI box can stall the
         # no-plan run's stealing into an unusually LOW served_off —
         # both measurements are re-taken together so the comparison
-        # stays within one load regime
-        for attempt in range(2):
+        # stays within one load regime.  Attempts print their numbers
+        # so an eventual failure is diagnosable from the CI log.
+        import sys
+
+        history = []
+        for attempt in range(3):
             served_off, _ = await run(False)
             served_on, (plans, hits) = await run(True)
+            history.append(
+                (attempt, served_on, served_off, plans, hits)
+            )
+            print(
+                f"# locality attempt {attempt}: served_on={served_on} "
+                f"served_off={served_off} plans={plans} hits={hits}",
+                file=sys.stderr,
+            )
             assert plans >= 1
             assert hits > 0
             # the whole point: the plan must cut peer transfers hard
             if served_on < 0.75 * served_off:
                 return
-        raise AssertionError((served_on, served_off))
+        raise AssertionError(history)
 
     asyncio.run(main())
