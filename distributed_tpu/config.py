@@ -167,6 +167,7 @@ defaults: dict[str, Any] = {
         "restart-debounce": "50ms",      # coalescing window for restart causes
     },
     "nanny": {
+        "blocked-handlers": [],
         "preload": [],
         "preload-argv": [],
         "environ": {},

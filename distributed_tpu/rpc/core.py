@@ -182,6 +182,7 @@ class Server:
 
     default_ip = ""
     default_port = 0
+    blocked_handlers_config_key = "scheduler.blocked-handlers"
 
     def __init__(
         self,
@@ -199,9 +200,15 @@ class Server:
         }
         if handlers:
             self.handlers.update(handlers)
-        blocked = set(config.get("scheduler.blocked-handlers") or [])
-        for op in blocked:
-            self.handlers.pop(op, None)
+        # per-node-type blocklist (reference worker.py blocked_handlers):
+        # Worker/Nanny override blocked_handlers_config_key so each node
+        # type is governed by its own config key.  Enforced at DISPATCH
+        # (not by popping here): subclasses and extensions register
+        # handlers after this __init__ runs, and those must be
+        # blockable too.
+        self._blocked_handlers = frozenset(
+            config.get(self.blocked_handlers_config_key) or []
+        )
         self.stream_handlers: dict[str, Callable] = dict(stream_handlers or {})
         self.connection_args = connection_args or {}
         self.deserialize = deserialize
@@ -333,7 +340,10 @@ class Server:
                 reply = msg.pop("reply", True)
                 serializers = msg.pop("serializers", None)  # noqa: F841 - compat
                 self.counters[op] = self.counters.get(op, 0) + 1
-                handler = self.handlers.get(op)
+                handler = (
+                    None if op in self._blocked_handlers
+                    else self.handlers.get(op)
+                )
                 if handler is None:
                     result: Any = error_message(ValueError(
                         f"unknown operation {op!r} on {type(self).__name__}"))

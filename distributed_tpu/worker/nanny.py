@@ -51,6 +51,8 @@ def _run_worker_process(scheduler_addr: str, worker_kwargs: dict,
 class Nanny(Server):
     """Worker supervisor process (reference nanny.py:69)."""
 
+    blocked_handlers_config_key = "nanny.blocked-handlers"
+
     def __init__(
         self,
         scheduler_addr: str,

@@ -67,6 +67,8 @@ logger = logging.getLogger("distributed_tpu.worker")
 class Worker(Server):
     """Executes tasks, stores results, serves peers (reference worker.py:264)."""
 
+    blocked_handlers_config_key = "worker.blocked-handlers"
+
     def __init__(
         self,
         scheduler_addr: str,

@@ -496,3 +496,26 @@ async def test_paused_at_startup_reconciled_via_heartbeat():
             assert await asyncio.wait_for(c.gather(futs), 60) == [
                 i + 1 for i in range(12)
             ]
+
+
+@gen_test()
+async def test_blocked_handlers_per_node_type():
+    """worker.blocked-handlers governs workers and
+    scheduler.blocked-handlers the scheduler — independently
+    (reference worker.py blocked_handlers)."""
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.rpc.core import rpc
+
+    with dtpu_config.set({"worker.blocked-handlers": ["run"]}):
+        async with await new_cluster(n_workers=1) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                # tasks still run (compute path is a stream, not "run")
+                assert await c.submit(lambda: 5, key="bh-1").result() == 5
+                # the worker's "run" RPC is blocked...
+                w = cluster.workers[0]
+                async with rpc(w.address) as r:
+                    with pytest.raises(ValueError, match="unknown operation"):
+                        await r.send_recv(op="run", reply=True, function=None)
+                # ...but the scheduler's handlers are untouched
+                ident = await c.scheduler.identity()
+                assert ident["workers"]

@@ -202,3 +202,29 @@ async def test_scheduler_restart_cycles_nannied_worker():
                 assert nanny.process.pid != old_pid, "worker not cycled"
                 fut = c.submit(lambda: 11, key="post", pure=False)
                 assert await asyncio.wait_for(fut.result(), 60) == 11
+
+
+@gen_test(timeout=90)
+async def test_nanny_blocked_handlers_key():
+    """nanny.blocked-handlers governs the nanny independently of the
+    worker/scheduler keys (each node type owns its blocklist)."""
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.rpc.core import rpc
+    from distributed_tpu.scheduler.server import Scheduler
+    from distributed_tpu.worker.nanny import Nanny
+
+    with dtpu_config.set({"nanny.blocked-handlers": ["run"]}):
+        async with Scheduler(listen_addr="tcp://127.0.0.1:0",
+                             http_port=None) as s:
+            async with Nanny(s.address, nthreads=1) as n:
+                # the nanny's own "run" RPC is blocked
+                async with rpc(n.address) as r:
+                    with pytest.raises(ValueError,
+                                       match="unknown operation"):
+                        await r.send_recv(op="run", reply=True,
+                                          function=None)
+                # but the worker under it still computes
+                from distributed_tpu.client.client import Client
+
+                async with Client(s.address) as c:
+                    assert await c.submit(lambda: 6, key="nb-1").result() == 6
