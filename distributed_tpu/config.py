@@ -39,6 +39,8 @@ defaults: dict[str, Any] = {
         # bytes/bandwidth, reference scheduler.py:3131).
         "transfer-latency": "500us",
         "blocked-handlers": [],
+        "preload": [],
+        "preload-argv": [],
         "default-task-durations": {"rechunk-split": "1us", "split-shuffle": "1us"},
         "events-cleanup-delay": "1h",
         "idle-timeout": None,

@@ -183,18 +183,41 @@ class Adaptive:
     def __init__(
         self,
         cluster: Cluster | None = None,
-        minimum: int = 0,
-        maximum: float = float("inf"),
-        interval: float = 1.0,
-        wait_count: int = 3,
-        target_duration: float = 5.0,
+        minimum: int | None = None,
+        maximum: float | None = None,
+        interval: float | None = None,
+        wait_count: int | None = None,
+        target_duration: float | None = None,
     ):
+        from distributed_tpu import config
+
         self.cluster = cluster
-        self.minimum = minimum
-        self.maximum = maximum
-        self.interval = interval
-        self.wait_count = wait_count
-        self.target_duration = target_duration
+        # config-backed defaults (reference distributed.yaml:209-215
+        # adaptive.*): explicit arguments win
+        self.minimum = (
+            minimum if minimum is not None
+            else int(config.get("adaptive.minimum") or 0)
+        )
+        cfg_max = config.get("adaptive.maximum")
+        self.maximum = (
+            maximum if maximum is not None
+            else (float(cfg_max) if cfg_max not in (None, ".inf", "inf")
+                  else float("inf"))
+        )
+        self.interval = (
+            interval if interval is not None
+            else config.parse_timedelta(config.get("adaptive.interval") or "1s")
+        )
+        self.wait_count = (
+            wait_count if wait_count is not None
+            else int(config.get("adaptive.wait-count") or 3)
+        )
+        self.target_duration = (
+            target_duration if target_duration is not None
+            else config.parse_timedelta(
+                config.get("adaptive.target-duration") or "5s"
+            )
+        )
         self._task: asyncio.Task | None = None
         self._rpc: Any | None = None
         self._down_streak = 0

@@ -183,6 +183,11 @@ class Server:
     default_ip = ""
     default_port = 0
     blocked_handlers_config_key = "scheduler.blocked-handlers"
+    # node types with config-driven preloads (reference distributed.yaml
+    # scheduler.preload / worker.preload / nanny.preload) set this to
+    # their config prefix; CLI --preload flags are handled by the CLIs
+    # and ADD to these
+    preload_config_prefix: str | None = None
 
     def __init__(
         self,
@@ -275,12 +280,38 @@ class Server:
             self.status = Status.starting
             try:
                 await self.start_unsafe()
+                await self._start_config_preloads()
             except Exception:
                 self.status = Status.failed
                 await self.close()
                 raise
             self.status = Status.running
         return self
+
+    async def _start_config_preloads(self) -> None:
+        self._config_preloads: list = []
+        if not self.preload_config_prefix:
+            return
+        from distributed_tpu.preloading import process_preloads
+
+        specs = config.get(f"{self.preload_config_prefix}.preload", None)
+        argv = config.get(f"{self.preload_config_prefix}.preload-argv", None)
+        self._config_preloads = process_preloads(self, specs, argv or None)
+        for preload in self._config_preloads:
+            await preload.start()
+
+    async def _teardown_config_preloads(self) -> None:
+        """Idempotent; subclasses call this FIRST in their close() so
+        dtpu_teardown hooks still see a live cluster (matching the CLI
+        flag ordering); Server.close is the backstop."""
+        preloads, self._config_preloads = (
+            getattr(self, "_config_preloads", []), []
+        )
+        for preload in preloads:
+            try:
+                await preload.teardown()
+            except Exception:
+                logger.exception("preload teardown failed")
 
     def start_periodic_callbacks(self) -> None:
         for pc in self.periodic_callbacks.values():
@@ -298,6 +329,7 @@ class Server:
             return
         self._close_started = True
         self.status = Status.closing
+        await self._teardown_config_preloads()
         for pc in self.periodic_callbacks.values():
             pc.stop()
         self.periodic_callbacks.clear()

@@ -56,6 +56,7 @@ class Scheduler(Server):
     """Central control plane (reference scheduler.py:3453)."""
 
     default_port = 8786
+    preload_config_prefix = "scheduler"
 
     def __init__(
         self,
@@ -254,6 +255,19 @@ class Scheduler(Server):
             self.periodic_callbacks["worker-ttl"] = PeriodicCallback(
                 self.check_worker_ttl, max(self.worker_ttl / 4, 0.25)
             )
+        no_workers_timeout = config.parse_timedelta(
+            config.get("scheduler.no-workers-timeout") or "0"
+        )
+        if no_workers_timeout:
+            def _check_no_workers() -> None:
+                cm, wm = self.state.stimulus_no_workers_timeout(
+                    no_workers_timeout, seq_name("no-workers-timeout")
+                )
+                self.send_all(cm, wm)
+
+            self.periodic_callbacks["no-workers-timeout"] = PeriodicCallback(
+                _check_no_workers, max(no_workers_timeout / 4, 0.25)
+            )
         if self.idle_timeout:
             self.periodic_callbacks["idle-timeout"] = PeriodicCallback(
                 self.check_idle, max(self.idle_timeout / 4, 0.25)
@@ -269,6 +283,9 @@ class Scheduler(Server):
         if self.status == Status.closed or self._close_begun:
             await self.finished()
             return
+        # dtpu_teardown hooks run against a LIVE cluster (same ordering
+        # as the CLI flag path); idempotent backstop in Server.close
+        await self._teardown_config_preloads()
         self._close_begun = True
         self.status = Status.closing
         logger.info("closing scheduler %s", self.id)

@@ -469,6 +469,7 @@ class SchedulerState:
             ("processing", "memory"): self._transition_processing_memory,
             ("processing", "erred"): self._transition_processing_erred,
             ("no-worker", "released"): self._transition_no_worker_released,
+            ("no-worker", "erred"): self._transition_no_worker_erred,
             ("no-worker", "processing"): self._transition_no_worker_processing,
             ("released", "forgotten"): self._transition_released_forgotten,
             ("memory", "forgotten"): self._transition_memory_forgotten,
@@ -1138,6 +1139,31 @@ class SchedulerState:
         self._propagate_released_followup(ts, recommendations)
         return recommendations, {}, {}
 
+    def _transition_no_worker_erred(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
+        """no-workers-timeout expiry: unsatisfiable restrictions fail the
+        task instead of parking it forever (reference no-workers-timeout)."""
+        ts = self.tasks[key]
+        del self.unrunnable[ts]
+        recommendations: dict[Key, str] = {}
+        # deregister from dependencies exactly like processing->erred:
+        # the failed task must not pin its (possibly in-memory) deps
+        for dts in ts.dependencies:
+            dts.waiters.discard(ts)
+            if not dts.waiters and not dts.who_wants:
+                recommendations[dts.key] = "released"
+        # a bare-dep reroute can park a no-worker task with waiting_on
+        # set; released->erred asserts it empty under validate
+        for dts in list(ts.waiting_on):
+            dts.waiters.discard(ts)
+        ts.waiting_on.clear()
+        ts.state = "released"
+        self._count_transition(ts, "no-worker", "released")
+        recs2, client_msgs, worker_msgs = self._transition_released_erred(
+            key, stimulus_id
+        )
+        recommendations.update(recs2)
+        return recommendations, client_msgs, worker_msgs
+
     def _transition_no_worker_processing(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
         ts = self.tasks[key]
         if ws := self.decide_worker_non_rootish(ts):
@@ -1770,6 +1796,36 @@ class SchedulerState:
             for ts in self.queued_unparked.peekn(remaining):
                 recs[ts.key] = "processing"
         return recs
+
+    def stimulus_no_workers_timeout(
+        self, timeout: float, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """Fail tasks stuck in no-worker longer than ``timeout``
+        (reference scheduler.no-workers-timeout): their restrictions
+        cannot be satisfied by the current fleet, and waiting forever
+        hides the misconfiguration from the client."""
+        now = time()
+        recs: dict[Key, str] = {}
+        for ts, since in list(self.unrunnable.items()):
+            if now - since <= timeout:
+                continue
+            exc = NoValidWorkerError(
+                ts.key,
+                worker_restrictions=sorted(ts.worker_restrictions)
+                if ts.worker_restrictions else None,
+                resource_restrictions=dict(ts.resource_restrictions)
+                if ts.resource_restrictions else None,
+            )
+            ts.exception = exc
+            ts.exception_text = (
+                f"no running worker satisfies the restrictions of "
+                f"{ts.key!r} within the no-workers-timeout"
+            )
+            ts.exception_blame = ts
+            recs[ts.key] = "erred"
+        if not recs:
+            return {}, {}
+        return self.transitions(recs, stimulus_id)
 
     # ------------------------------------------------------ replica model
 

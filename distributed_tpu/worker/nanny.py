@@ -52,6 +52,7 @@ class Nanny(Server):
     """Worker supervisor process (reference nanny.py:69)."""
 
     blocked_handlers_config_key = "nanny.blocked-handlers"
+    preload_config_prefix = "nanny"
 
     def __init__(
         self,
@@ -323,6 +324,7 @@ class Nanny(Server):
             await self.finished()
             return
         self.status = Status.closing
+        await self._teardown_config_preloads()
         logger.info("closing nanny %s", self.address)
         if self._lifetime_task is not None:
             self._lifetime_task.cancel()

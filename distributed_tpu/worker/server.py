@@ -68,6 +68,7 @@ class Worker(Server):
     """Executes tasks, stores results, serves peers (reference worker.py:264)."""
 
     blocked_handlers_config_key = "worker.blocked-handlers"
+    preload_config_prefix = "worker"
 
     def __init__(
         self,
@@ -452,6 +453,7 @@ class Worker(Server):
             await self.finished()
             return
         self.status = Status.closing
+        await self._teardown_config_preloads()
         logger.info("closing worker %s", self.address)
         if self._lifetime_task is not None:
             self._lifetime_task.cancel()

@@ -362,3 +362,101 @@ async def test_forward_output_streams_prints_to_client():
                 import sys as _sys
 
                 assert not hasattr(_sys.stdout, "_inner")
+
+
+@gen_test(timeout=60)
+async def test_config_driven_preloads():
+    """scheduler.preload / worker.preload from CONFIG run at node start
+    (reference distributed.yaml:27-28,90-91) — not only CLI flags."""
+    import os
+    import tempfile
+
+    from distributed_tpu import config as dtpu_config
+
+    with tempfile.TemporaryDirectory() as td:
+        marker = os.path.join(td, "preload-ran")
+        src = (
+            "def dtpu_setup(worker):\n"
+            f"    open({marker!r}, 'a').write(type(worker).__name__ + '\\n')\n"
+        )
+        with dtpu_config.set({
+            "scheduler.preload": [src],
+            "worker.preload": [src],
+        }):
+            async with LocalCluster(n_workers=1, threads_per_worker=1) as cluster:
+                async with Client(cluster.scheduler_address) as c:
+                    assert await c.submit(lambda: 1, key="pl-1").result() == 1
+        kinds = sorted(open(marker).read().split())
+        assert "Scheduler" in kinds and "Worker" in kinds, kinds
+
+
+@gen_test(timeout=60)
+async def test_no_workers_timeout_fails_unsatisfiable_tasks():
+    """A task whose restrictions no worker can satisfy errs after
+    scheduler.no-workers-timeout instead of parking forever."""
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.exceptions import NoValidWorkerError
+
+    with dtpu_config.set({"scheduler.no-workers-timeout": "500ms"}):
+        async with LocalCluster(n_workers=1, threads_per_worker=1) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                fut = c.submit(lambda: 1, key="impossible",
+                               resources={"GPU": 1})  # nobody has GPUs
+                with pytest.raises(NoValidWorkerError):
+                    await asyncio.wait_for(fut.result(), 30)
+                # healthy tasks unaffected
+                assert await c.submit(lambda: 2, key="fine").result() == 2
+
+
+@gen_test(timeout=60)
+async def test_config_preload_teardown_sees_live_cluster():
+    """dtpu_teardown from CONFIG preloads runs before the node tears
+    its comms down (same ordering as the CLI flag path)."""
+    import os
+    import tempfile
+
+    from distributed_tpu import config as dtpu_config
+
+    with tempfile.TemporaryDirectory() as td:
+        marker = os.path.join(td, "teardown")
+        src = (
+            "def dtpu_setup(worker):\n"
+            "    pass\n"
+            "def dtpu_teardown(worker):\n"
+            "    alive = not worker.batched_stream.closed()\n"
+            f"    open({marker!r}, 'a').write(str(alive) + '\\n')\n"
+        )
+        with dtpu_config.set({"worker.preload": [src]}):
+            async with LocalCluster(n_workers=1, threads_per_worker=1) as cluster:
+                async with Client(cluster.scheduler_address) as c:
+                    assert await c.submit(lambda: 1, key="td-1").result() == 1
+        lines = open(marker).read().split()
+        assert lines == ["True"], lines  # stream was live at teardown
+
+
+@gen_test(timeout=60)
+async def test_no_workers_timeout_does_not_pin_dependencies():
+    """An erred-by-timeout task deregisters from its dependencies so a
+    finished dep is not pinned in memory forever."""
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.exceptions import NoValidWorkerError
+
+    with dtpu_config.set({"scheduler.no-workers-timeout": "500ms"}):
+        async with LocalCluster(n_workers=1, threads_per_worker=1) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                dep = c.submit(lambda: 11, key="dep-ok")
+                assert await dep.result() == 11
+                bad = c.submit(lambda x: x, dep, key="bad-gpu",
+                               resources={"GPU": 1})
+                with pytest.raises(NoValidWorkerError):
+                    await asyncio.wait_for(bad.result(), 30)
+                sts = cluster.scheduler.state.tasks["dep-ok"]
+                assert not [w.key for w in sts.waiters], sts.waiters
+                # releasing both futures must actually free the dep
+                bad.release()
+                dep.release()
+                for _ in range(100):
+                    if "dep-ok" not in cluster.scheduler.state.tasks:
+                        break
+                    await asyncio.sleep(0.05)
+                assert "dep-ok" not in cluster.scheduler.state.tasks
