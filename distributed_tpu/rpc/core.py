@@ -289,6 +289,9 @@ class Server:
         return self
 
     async def _start_config_preloads(self) -> None:
+        if getattr(self, "_config_preloads_started", False):
+            return  # a subclass ran them at its preferred point
+        self._config_preloads_started = True
         self._config_preloads: list = []
         if not self.preload_config_prefix:
             return

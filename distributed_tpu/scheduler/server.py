@@ -283,10 +283,13 @@ class Scheduler(Server):
         if self.status == Status.closed or self._close_begun:
             await self.finished()
             return
+        # the flag flips BEFORE the first await below: a concurrent
+        # close() arriving while a dtpu_teardown hook runs must not
+        # re-enter the body and double-close comms/extensions
+        self._close_begun = True
         # dtpu_teardown hooks run against a LIVE cluster (same ordering
         # as the CLI flag path); idempotent backstop in Server.close
         await self._teardown_config_preloads()
-        self._close_begun = True
         self.status = Status.closing
         logger.info("closing scheduler %s", self.id)
         for pc in self.periodic_callbacks.values():

@@ -34,6 +34,7 @@ async def gather_from_workers(
     data: dict[str, Any] = {}
     missing: set[str] = set()
     failed_workers: set[str] = set()
+    busy_rounds = 0
     remaining: dict[str, list[str]] = {
         k: list(ws) for k, ws in who_has.items() if ws
     }
@@ -62,12 +63,18 @@ async def gather_from_workers(
         results = await asyncio.gather(
             *(fetch(w, ks) for w, ks in by_worker.items())
         )
+        any_busy = False
         for worker, resp in results:
             keys = by_worker[worker]
             if resp is None:
                 failed_workers.add(worker)
                 for k in keys:
                     remaining[k] = [w for w in remaining.get(k, []) if w != worker]
+                continue
+            if resp.get("status") == "busy":
+                # over its outgoing-serve limit: the holder still has
+                # the data — keep it and retry next round
+                any_busy = True
                 continue
             got = resp.get("data", {})
             for k in keys:
@@ -80,6 +87,19 @@ async def gather_from_workers(
                     if not remaining[k]:
                         missing.add(k)
                         remaining.pop(k, None)
+        if any_busy:
+            busy_rounds += 1
+            if busy_rounds > 12:
+                # ~30s of capped exponential backoff exhausted: report
+                # the still-remaining keys missing instead of hammering
+                # an overloaded holder forever — callers (scheduler
+                # gather retry, worker missing->refresh) have their own
+                # higher-level recovery
+                missing.update(remaining)
+                break
+            await asyncio.sleep(min(0.05 * 2 ** busy_rounds, 5.0))
+        else:
+            busy_rounds = 0
     return data, missing, sorted(failed_workers)
 
 

@@ -174,6 +174,13 @@ class Worker(Server):
         # placement quality shows up directly as fewer get_data serves)
         self.get_data_requests = 0
         self.get_data_keys_served = 0
+        # concurrent get_data serves (reply writes included); beyond the
+        # limit peers get {"status": "busy"} (reference
+        # connections.outgoing, worker.py:~1740)
+        self._outgoing_serves = 0
+        self._outgoing_limit = int(
+            (config.get("worker.connections") or {}).get("outgoing") or 50
+        )
         self.scheduler_comm: Comm | None = None
         self.heartbeat_interval = (
             heartbeat_interval if heartbeat_interval is not None else 1.0
@@ -317,6 +324,12 @@ class Worker(Server):
                 port=self._http_port,
             )
             await self.http_server.start()
+        # config preloads run BEFORE registration (reference worker
+        # ordering): the scheduler may assign tasks the moment the
+        # worker registers, and dtpu_setup must have prepared the
+        # environment by then.  Idempotent: Server.start's later call
+        # becomes a no-op.
+        await self._start_config_preloads()
         await self._register_with_scheduler()
         if self.heartbeat_interval > 0:
             self.periodic_callbacks["heartbeat"] = PeriodicCallback(
@@ -496,23 +509,40 @@ class Worker(Server):
     # --------------------------------------------------------- RPC handlers
 
     async def get_data(
-        self, keys: tuple = (), who: str | None = None, **kwargs: Any
-    ) -> dict:
-        """Serve locally-held task data to a peer (reference worker.py:1722)."""
-        t0 = time()
-        data = {}
-        for k in keys:
-            if k in self.data:
-                data[k] = Serialize(self.data[k])
-        self.get_data_requests += 1
-        self.get_data_keys_served += len(data)
-        nbytes = {k: self.state.tasks[k].nbytes if k in self.state.tasks
-                  else sizeof(self.data[k]) for k in data}
-        self._fine_metric("get-data", None, "", "serve", "seconds", time() - t0)
-        self._fine_metric(
-            "get-data", None, "", "serve", "bytes", float(sum(nbytes.values()))
-        )
-        return {"status": "OK", "data": data, "nbytes": nbytes}
+        self, comm: Comm, keys: tuple = (), who: str | None = None,
+        **kwargs: Any
+    ) -> Any:
+        """Serve locally-held task data to a peer (reference worker.py:1722).
+
+        Outgoing-serve backpressure (reference connections.outgoing=50):
+        the handler writes its own reply so the WRITE — where a slow
+        peer's TCP window actually blocks — counts against the limit;
+        over the limit the peer gets ``{"status": "busy"}`` and retries
+        elsewhere or later (GatherDepBusyEvent path)."""
+        if self._outgoing_serves >= self._outgoing_limit:
+            return {"status": "busy"}
+        self._outgoing_serves += 1
+        try:
+            t0 = time()
+            data = {}
+            for k in keys:
+                if k in self.data:
+                    data[k] = Serialize(self.data[k])
+            self.get_data_requests += 1
+            self.get_data_keys_served += len(data)
+            nbytes = {k: self.state.tasks[k].nbytes if k in self.state.tasks
+                      else sizeof(self.data[k]) for k in data}
+            self._fine_metric(
+                "get-data", None, "", "serve", "seconds", time() - t0
+            )
+            self._fine_metric(
+                "get-data", None, "", "serve", "bytes",
+                float(sum(nbytes.values())),
+            )
+            await comm.write({"status": "OK", "data": data, "nbytes": nbytes})
+            return Status.dont_reply
+        finally:
+            self._outgoing_serves -= 1
 
     async def gather(self, who_has: dict[Key, list[str]] | None = None) -> dict:
         """Pull keys from peers into local memory (reference worker.py:1274)."""

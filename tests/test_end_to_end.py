@@ -519,3 +519,59 @@ async def test_blocked_handlers_per_node_type():
                 # ...but the scheduler's handlers are untouched
                 ident = await c.scheduler.identity()
                 assert ident["workers"]
+
+
+@gen_test(timeout=60)
+async def test_get_data_busy_backpressure():
+    """Over worker.connections.outgoing concurrent serves, peers get
+    {'status': 'busy'} and retry (reference worker.py outgoing limit +
+    the GatherDepBusyEvent path)."""
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.rpc.core import rpc
+
+    with dtpu_config.set({"worker.connections": {"outgoing": 1,
+                                                 "incoming": 10}}):
+        async with await new_cluster(n_workers=1) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                fut = c.submit(lambda: 1, key="served")  # held: keep the key
+                assert await fut.result() == 1
+                w = cluster.workers[0]
+                assert w._outgoing_limit == 1
+                # deterministic saturation: fill the counter directly
+                w._outgoing_serves = w._outgoing_limit
+                async with rpc(w.address) as r:
+                    resp = await r.get_data(keys=["served"])
+                assert resp == {"status": "busy"}, resp
+                w._outgoing_serves = 0
+                async with rpc(w.address) as r:
+                    resp = await r.get_data(keys=["served"])
+                assert resp["status"] == "OK"
+                from distributed_tpu.protocol.serialize import nested_deserialize
+                assert nested_deserialize(resp["data"])["served"] == 1
+
+
+@gen_test(timeout=60)
+async def test_gather_from_workers_retries_busy_holder():
+    """A busy holder keeps its data: gather retries it instead of
+    treating the key as lost."""
+    from distributed_tpu.utils.comm import gather_from_workers
+
+    calls = {"n": 0}
+
+    class FakeRPC:
+        def __init__(self, addr):
+            pass
+
+        async def get_data(self, keys=(), who=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return {"status": "busy"}
+            return {"status": "OK",
+                    "data": {k: f"v-{k}" for k in keys},
+                    "nbytes": {k: 8 for k in keys}}
+
+    data, missing, failed = await gather_from_workers(
+        {"k1": ["tcp://w:1"]}, rpc=FakeRPC
+    )
+    assert data == {"k1": "v-k1"} and not missing and not failed
+    assert calls["n"] == 2

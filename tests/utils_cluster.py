@@ -194,10 +194,10 @@ class BlockedGetData(Worker):
         self.block_get_data = asyncio.Event()
         super().__init__(*args, **kwargs)
 
-    async def get_data(self, keys=(), who=None, **kwargs):
+    async def get_data(self, comm, keys=(), who=None, **kwargs):
         self.in_get_data.set()
         await self.block_get_data.wait()
-        return await super().get_data(keys=keys, who=who, **kwargs)
+        return await super().get_data(comm, keys=keys, who=who, **kwargs)
 
 
 class BlockedExecute(Worker):
