@@ -181,6 +181,8 @@ class Client:
             else {}
         )
         self.id = f"Client-{name or ''}{uuid.uuid4().hex[:12]}"
+        self._heartbeat_interval = heartbeat_interval
+        self._heartbeat_pc: Any | None = None
         self.futures: dict[Key, FutureState] = {}
         # pickled-size cache for the large-closure warning: weak keys so
         # user functions die normally and ids are never reused stale
@@ -240,6 +242,26 @@ class Client:
             self.address, connection_args=self._connection_args
         )
         self._handle_report_task = asyncio.create_task(self._handle_report())
+        # liveness heartbeat on the batched stream (reference
+        # client.heartbeat 5s): the scheduler stamps ClientState.last_seen
+        interval = (
+            self._heartbeat_interval
+            if self._heartbeat_interval is not None
+            else config.parse_timedelta(config.get("client.heartbeat", "5s"))
+        )
+        if interval and interval > 0:
+            from distributed_tpu.rpc.core import PeriodicCallback
+
+            def _beat() -> None:
+                try:
+                    self.batched_stream.send(
+                        {"op": "heartbeat-client", "client": self.id}
+                    )
+                except Exception:
+                    pass
+
+            self._heartbeat_pc = PeriodicCallback(_beat, interval)
+            self._heartbeat_pc.start()
         self.status = "running"
         try:
             # one identity snapshot at connect so _repr_html_ (sync, must
@@ -270,6 +292,8 @@ class Client:
         if self.status == "closed":
             return
         self.status = "closed"
+        if self._heartbeat_pc is not None:
+            self._heartbeat_pc.stop()
         if self._handle_report_task is not None:
             self._handle_report_task.cancel()
             try:

@@ -385,7 +385,14 @@ class Server:
                 else:
                     try:
                         if _wants_comm(handler):
-                            result = handler(comm, **msg)
+                            # comm handlers that write their own reply
+                            # (get_data) must see the reply flag or a
+                            # reply=False caller gets an unsolicited
+                            # write that desyncs the pooled comm
+                            if _wants_reply_flag(handler):
+                                result = handler(comm, reply=reply, **msg)
+                            else:
+                                result = handler(comm, **msg)
                         else:
                             result = handler(**msg)
                         if inspect.isawaitable(result):
@@ -475,6 +482,21 @@ class Server:
         except ValueError:
             addr = "not-listening"
         return f"<{type(self).__name__} {addr!r} {self.status.name}>"
+
+
+def _wants_reply_flag(handler: Callable) -> bool:
+    cached = getattr(handler, "_wants_reply_flag", None)
+    if cached is None:
+        try:
+            params = inspect.signature(handler).parameters
+        except (TypeError, ValueError):
+            params = {}
+        cached = "reply" in params
+        try:
+            handler.__dict__["_wants_reply_flag"] = cached
+        except AttributeError:
+            pass
+    return cached
 
 
 def _wants_comm(handler: Callable) -> bool:

@@ -573,7 +573,9 @@ class Scheduler(Server):
         return Status.dont_reply
 
     def handle_heartbeat_client(self, client: str = "", **kwargs: Any) -> None:
-        pass
+        cs = self.state.clients.get(client)
+        if cs is not None:
+            cs.last_seen = time()
 
     async def handle_close_client(self, client: str = "", **kwargs: Any) -> None:
         bs = self.client_comms.get(client)

@@ -40,8 +40,10 @@ async def gather_from_workers(
     }
     missing.update(k for k, ws in who_has.items() if not ws)
 
+    busy_workers: set[str] = set()
     while remaining:
-        # group this round's fetches by worker
+        # group this round's fetches by worker; a holder that answered
+        # busy last round is picked only when no other holder exists
         by_worker: dict[str, list[str]] = defaultdict(list)
         for key, holders in list(remaining.items()):
             holders = [w for w in holders if w not in failed_workers]
@@ -49,7 +51,8 @@ async def gather_from_workers(
                 missing.add(key)
                 del remaining[key]
                 continue
-            by_worker[random.choice(holders)].append(key)
+            fresh = [w for w in holders if w not in busy_workers]
+            by_worker[random.choice(fresh or holders)].append(key)
         if not by_worker:
             break
 
@@ -64,6 +67,8 @@ async def gather_from_workers(
             *(fetch(w, ks) for w, ks in by_worker.items())
         )
         any_busy = False
+        progressed = False
+        busy_workers = set()
         for worker, resp in results:
             keys = by_worker[worker]
             if resp is None:
@@ -75,19 +80,21 @@ async def gather_from_workers(
                 # over its outgoing-serve limit: the holder still has
                 # the data — keep it and retry next round
                 any_busy = True
+                busy_workers.add(worker)
                 continue
             got = resp.get("data", {})
             for k in keys:
                 if k in got:
                     data[k] = _unwrap(got[k])
                     remaining.pop(k, None)
+                    progressed = True
                 else:
                     # holder no longer has it; drop this holder and retry
                     remaining[k] = [w for w in remaining.get(k, []) if w != worker]
                     if not remaining[k]:
                         missing.add(k)
                         remaining.pop(k, None)
-        if any_busy:
+        if any_busy and not progressed:
             busy_rounds += 1
             if busy_rounds > 12:
                 # ~30s of capped exponential backoff exhausted: report

@@ -510,7 +510,7 @@ class Worker(Server):
 
     async def get_data(
         self, comm: Comm, keys: tuple = (), who: str | None = None,
-        **kwargs: Any
+        reply: bool = True, **kwargs: Any
     ) -> Any:
         """Serve locally-held task data to a peer (reference worker.py:1722).
 
@@ -520,7 +520,7 @@ class Worker(Server):
         over the limit the peer gets ``{"status": "busy"}`` and retries
         elsewhere or later (GatherDepBusyEvent path)."""
         if self._outgoing_serves >= self._outgoing_limit:
-            return {"status": "busy"}
+            return {"status": "busy"} if reply else Status.dont_reply
         self._outgoing_serves += 1
         try:
             t0 = time()
@@ -539,7 +539,10 @@ class Worker(Server):
                 "get-data", None, "", "serve", "bytes",
                 float(sum(nbytes.values())),
             )
-            await comm.write({"status": "OK", "data": data, "nbytes": nbytes})
+            if reply:
+                await comm.write(
+                    {"status": "OK", "data": data, "nbytes": nbytes}
+                )
             return Status.dont_reply
         finally:
             self._outgoing_serves -= 1

@@ -575,3 +575,20 @@ async def test_gather_from_workers_retries_busy_holder():
     )
     assert data == {"k1": "v-k1"} and not missing and not failed
     assert calls["n"] == 2
+
+
+@gen_test(timeout=30)
+async def test_client_heartbeat_stamps_last_seen():
+    """The client's liveness heartbeat updates ClientState.last_seen
+    (reference client.heartbeat)."""
+    async with await new_cluster(n_workers=1) as cluster:
+        async with Client(cluster.scheduler_address,
+                          heartbeat_interval=0.1) as c:
+            await c.submit(lambda: 1, key="hb-c").result()
+            cs = cluster.scheduler.state.clients[c.id]
+            seen0 = cs.last_seen
+            for _ in range(50):
+                await asyncio.sleep(0.05)
+                if cs.last_seen > seen0:
+                    break
+            assert cs.last_seen > seen0
