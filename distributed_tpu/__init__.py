@@ -71,3 +71,18 @@ def __getattr__(name: str):
 
         return getattr(_pb, name)
     raise AttributeError(f"module 'distributed_tpu' has no attribute {name!r}")
+
+
+_LAZY = (
+    "Client", "Future", "as_completed", "wait", "fire_and_forget",
+    "Scheduler", "Worker", "Nanny", "LocalCluster", "SpecCluster",
+    "Adaptive", "Cluster", "Semaphore", "Lock", "MultiLock", "Event",
+    "Queue", "Variable", "Pub", "Sub", "Actor", "SchedulerPlugin",
+    "WorkerPlugin", "NannyPlugin", "SSHCluster", "SubprocessCluster",
+    "progress", "progress_sync",
+)
+
+
+def __dir__() -> list[str]:
+    # surface the lazy exports to dir()/tab-completion
+    return sorted(set(globals()) | set(_LAZY))
