@@ -1,0 +1,34 @@
+"""graft-lint: AST-based invariant checker for the TPU co-processor codebase.
+
+The control plane of this project only works because a handful of
+contracts hold everywhere, forever:
+
+- the transition engines (``scheduler/state.py``, ``worker/state_machine.py``)
+  and the graph layer are **sans-IO** — pure, deterministic state machines
+  that can be mirrored into device arrays and replayed as oracles;
+- event-loop code never blocks and never reads the wall clock;
+- RPC/stream senders and handler tables stay in keyword-level agreement
+  (a mismatched kwarg is a silent ``TypeError`` swallowed by the stream
+  loop);
+- jitted kernels in ``ops/`` stay pure and host-sync-free or silently
+  fall off the device fast path;
+- handler/server code never swallows exceptions silently.
+
+``graft-lint`` enforces those contracts statically.  Run it as::
+
+    python -m distributed_tpu.analysis [--format json]
+
+Rules live in :mod:`distributed_tpu.analysis.rules`; scoping lives in the
+repo-root ``graft-lint.toml``; intentional violations are allowlisted in
+``graft-lint-baseline.toml`` (every entry needs a ``reason``) or with an
+inline ``# graft-lint: allow[rule-name] reason`` pragma.
+"""
+
+from distributed_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    all_rules,
+    register,
+    run_lint,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from distributed_tpu.analysis.cli import main
+
+sys.exit(main())

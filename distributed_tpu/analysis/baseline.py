@@ -1,0 +1,106 @@
+"""Allowlist/baseline file: intentional violations, each with a reason.
+
+``graft-lint-baseline.toml`` holds ``[[allow]]`` tables::
+
+    [[allow]]
+    rule = "swallowed-exceptions"
+    path = "distributed_tpu/worker/memory.py"
+    symbol = "_set_status"          # optional: enclosing function / op
+    contains = "batched_stream"     # optional: substring of the message
+    reason = "pause announce must never fail; stream may not exist yet"
+
+``rule``, ``path`` and a non-empty ``reason`` are mandatory; ``symbol`` /
+``line`` / ``contains`` narrow the match.  Entries that match nothing are
+reported as stale so the baseline can only shrink, never rot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+try:
+    import tomllib  # py311+
+except ImportError:  # pragma: no cover - py310 fallback
+    import tomli as tomllib  # type: ignore[no-redef]
+
+if TYPE_CHECKING:
+    from distributed_tpu.analysis.core import Finding
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    path: str
+    reason: str
+    symbol: str = ""
+    line: int = 0
+    contains: str = ""
+    used: bool = False
+
+    def matches(self, finding: "Finding") -> bool:
+        if self.rule != finding.rule or self.path != finding.path:
+            return False
+        if self.symbol and self.symbol != finding.symbol:
+            return False
+        if self.line and self.line != finding.line:
+            return False
+        if self.contains and self.contains not in finding.message:
+            return False
+        return True
+
+
+@dataclass
+class Baseline:
+    entries: list[AllowEntry] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        self = cls()
+        if not path.is_file():
+            return self
+        try:
+            data = tomllib.loads(path.read_text())
+        except tomllib.TOMLDecodeError as e:
+            self.errors.append(f"{path.name}: {e}")
+            return self
+        for i, raw in enumerate(data.get("allow") or []):
+            rule = str(raw.get("rule", ""))
+            rel = str(raw.get("path", ""))
+            reason = str(raw.get("reason", "")).strip()
+            if not (rule and rel):
+                self.errors.append(
+                    f"{path.name}: allow[{i}] needs 'rule' and 'path'"
+                )
+                continue
+            if not reason:
+                # an unjustified allowlist entry is itself a finding: the
+                # whole point is that every suppression argues its case
+                self.errors.append(
+                    f"{path.name}: allow[{i}] ({rule} @ {rel}) has no reason"
+                )
+                continue
+            self.entries.append(AllowEntry(
+                rule=rule, path=rel, reason=reason,
+                symbol=str(raw.get("symbol", "")),
+                line=int(raw.get("line", 0)),
+                contains=str(raw.get("contains", "")),
+            ))
+        return self
+
+    def allows(self, finding: "Finding") -> bool:
+        hit = False
+        for entry in self.entries:
+            if entry.matches(finding):
+                entry.used = True
+                hit = True  # keep scanning: mark ALL matching entries used
+        return hit
+
+    def unused(self) -> list[str]:
+        return [
+            f"{e.rule} @ {e.path}" + (f" [{e.symbol}]" if e.symbol else "")
+            for e in self.entries
+            if not e.used
+        ]

@@ -1,0 +1,86 @@
+"""``python -m distributed_tpu.analysis`` — run graft-lint.
+
+Exit status: 0 clean, 1 findings (or broken baseline entries), 2 usage
+error.  ``--format json`` emits a machine-readable report for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from distributed_tpu.analysis.baseline import Baseline
+from distributed_tpu.analysis.config import LintConfig
+from distributed_tpu.analysis.core import all_rules, run_lint
+
+
+def default_root() -> Path:
+    """Repo root = parent of the installed/checked-out package dir."""
+    import distributed_tpu
+
+    return Path(distributed_tpu.__file__).resolve().parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_tpu.analysis",
+        description="graft-lint: static invariant checks for the "
+                    "distributed_tpu codebase",
+    )
+    parser.add_argument("--root", type=Path, default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--format", choices=("human", "json"), default="human")
+    parser.add_argument("--rule", action="append", dest="rules", default=None,
+                        metavar="NAME", help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--verbose", "-v", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for name in sorted(rules):
+            print(f"{name:24s} {rules[name].description}")
+        return 0
+
+    root = (args.root or default_root()).resolve()
+    if not (root / "distributed_tpu").is_dir():
+        print(f"error: {root} does not contain a distributed_tpu package",
+              file=sys.stderr)
+        return 2
+
+    config = LintConfig.load(root)
+    baseline = Baseline.load(root / config.baseline_file)
+    result = run_lint(
+        root, config=config, baseline=baseline, rule_names=args.rules,
+        log=(lambda m: print(f"# {m}", file=sys.stderr)) if args.verbose else None,
+    )
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in result.findings],
+            "errors": result.errors,
+            "suppressed": result.suppressed,
+            "stale_baseline": result.stale_baseline,
+            "exit_code": result.exit_code,
+        }, indent=2))
+        return result.exit_code
+
+    for err in result.errors:
+        print(f"error: {err}")
+    for finding in result.findings:
+        print(finding.format())
+    for stale in result.stale_baseline:
+        print(f"warning: stale baseline entry (matched nothing): {stale}")
+    n = len(result.findings)
+    print(
+        f"graft-lint: {n} finding{'s' if n != 1 else ''}, "
+        f"{result.suppressed} suppressed by pragma/baseline"
+        + (f", {len(result.errors)} errors" if result.errors else "")
+    )
+    return result.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

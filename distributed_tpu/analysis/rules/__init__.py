@@ -1,0 +1,10 @@
+"""Rule modules self-register on import; import them all here."""
+
+from distributed_tpu.analysis.rules import (  # noqa: F401
+    blocking_async,
+    handler_parity,
+    jit_purity,
+    monotonic_time,
+    sans_io,
+    swallowed,
+)

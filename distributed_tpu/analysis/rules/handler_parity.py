@@ -1,0 +1,271 @@
+"""handler-parity: RPC/stream senders must agree with the handler tables.
+
+The dispatch planes are stringly typed: a request ``{"op": ...}`` is looked
+up in ``Server.handlers`` / ``Server.stream_handlers`` and invoked as
+``handler(**msg)``.  An op nobody registered is an error reply (RPC) or a
+logged-and-dropped message (stream); a keyword the handler doesn't accept
+is a ``TypeError`` that the stream loop swallows into a log line while the
+task it carried wedges.  Both are invisible until a cluster hangs — and
+both are fully decidable from the AST.
+
+This whole-program rule:
+
+1. extracts every handler table in the package — ``handlers = {...}`` /
+   ``stream_handlers = {...}`` dict literals, later ``X.handlers["op"] =``
+   subscript registrations and ``X.handlers.update({...})`` bulk
+   registrations (extensions included), and manual dispatch arms
+   (``op == "literal"`` / ``msg.get("op") == "literal"`` comparisons,
+   which also teaches it the protocol-internal ops like ``close-stream``);
+2. resolves each handler to its def in the same module for keyword
+   checking (``self`` and the comm-injected first ``comm`` param are
+   dropped; ``lambda **kw`` accepts everything);
+3. walks every rpc-proxy call ``<...rpc(...)>.op(key=...)`` and every
+   literal message ``{"op": "name", key: ...}`` in the package and flags
+   ops with no handler anywhere, and keyword sets that **no** registered
+   handler for that op accepts.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from distributed_tpu.analysis import astutils
+from distributed_tpu.analysis.core import Finding, LintContext, Rule, register
+
+#: protocol-level keys stripped by the server before dispatch
+_PROTOCOL_KEYS = {"op", "reply", "serializers"}
+#: attrs that exist on the rpc proxy objects themselves — not ops
+_PROXY_ATTRS = {"send_recv", "close_rpc", "live_comm", "address", "comms",
+                "pool", "status", "timeout"}
+
+
+@dataclass
+class HandlerInfo:
+    op: str
+    table: str  # "handlers" | "stream_handlers" | "dispatch"
+    module: str
+    params: frozenset[str] | None  # None: unresolvable -> accepts anything
+    var_kwargs: bool = True
+
+    def accepts(self, keys: set[str]) -> bool:
+        if self.params is None or self.var_kwargs:
+            return True
+        return keys <= self.params
+
+
+def _table_name(target: ast.AST) -> str | None:
+    """'handlers'/'stream_handlers' if target is such a table reference."""
+    name = astutils.dotted(target)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return tail if tail in ("handlers", "stream_handlers") else None
+
+
+def _resolve_params(
+    handler_expr: ast.AST, defs: dict[str, list[ast.AST]]
+) -> tuple[frozenset[str] | None, bool]:
+    if isinstance(handler_expr, ast.Lambda):
+        a = handler_expr.args
+        names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+        return frozenset(names), a.kwarg is not None
+    name = astutils.dotted(handler_expr)
+    if name is None:
+        return None, True
+    fn_name = name.rsplit(".", 1)[-1]
+    candidates = defs.get(fn_name, [])
+    if len(candidates) != 1:
+        return None, True
+    fn = candidates[0]
+    params, var_kw = astutils.func_params(fn)  # type: ignore[arg-type]
+    params = set(params)
+    params.discard("self")
+    # first param 'comm' is injected by the server, never sent
+    a = fn.args  # type: ignore[union-attr]
+    ordered = [*a.posonlyargs, *a.args]
+    if ordered and ordered[0].arg == "self":
+        ordered = ordered[1:]
+    if ordered and ordered[0].arg == "comm":
+        params.discard("comm")
+    return frozenset(params), var_kw
+
+
+def _is_op_lookup(node: ast.AST) -> bool:
+    """``op`` variable or ``<msg>.get("op")`` — a dispatch-arm subject."""
+    if isinstance(node, ast.Name) and node.id == "op":
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("get", "pop")
+        and bool(node.args)
+        and astutils.const_str(node.args[0]) == "op"
+    )
+
+
+def _is_rpcish(base: ast.AST, relpath: str) -> bool:
+    """Does ``base.attr(...)`` look like an rpc-proxy op call?"""
+    if isinstance(base, ast.Call):
+        name = astutils.dotted(base.func) or ""
+        return name == "rpc" or name.endswith(".rpc")
+    name = astutils.dotted(base) or ""
+    # Client.scheduler is an `rpc` instance (client/client.py)
+    return name.endswith(".scheduler") and relpath.endswith("client/client.py")
+
+
+@register
+class HandlerParityRule(Rule):
+    name = "handler-parity"
+    description = (
+        "every rpc/stream op sent must have a registered handler, and its "
+        "keywords must be accepted by at least one such handler"
+    )
+    scope = ("distributed_tpu/**",)
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        modules = ctx.modules(self)
+        for mod in modules:
+            astutils.add_parents(mod.tree)
+
+        # ---------------------------------------- pass 1: handler tables
+        registry: dict[str, list[HandlerInfo]] = {}
+
+        def add(op: str, table: str, module: str, params, var_kw) -> None:
+            registry.setdefault(op, []).append(
+                HandlerInfo(op, table, module, params, var_kw)
+            )
+
+        for mod in modules:
+            defs: dict[str, list[ast.AST]] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, []).append(node)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        table = _table_name(target)
+                        if table and isinstance(node.value, ast.Dict):
+                            for k, v in zip(node.value.keys, node.value.values):
+                                op = astutils.const_str(k) if k else None
+                                if op:
+                                    params, var_kw = _resolve_params(v, defs)
+                                    add(op, table, mod.relpath, params, var_kw)
+                        elif (
+                            isinstance(target, ast.Subscript)
+                            and _table_name(target.value)
+                        ):
+                            op = astutils.const_str(target.slice)
+                            if op:
+                                params, var_kw = _resolve_params(node.value, defs)
+                                add(op, _table_name(target.value),  # type: ignore[arg-type]
+                                    mod.relpath, params, var_kw)
+                elif isinstance(node, ast.Call):
+                    # bulk registration: X.handlers.update({...})
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "update"
+                        and _table_name(node.func.value)
+                        and node.args
+                        and isinstance(node.args[0], ast.Dict)
+                    ):
+                        table = _table_name(node.func.value)
+                        for k, v in zip(node.args[0].keys,
+                                        node.args[0].values):
+                            op = astutils.const_str(k) if k else None
+                            if op:
+                                params, var_kw = _resolve_params(v, defs)
+                                add(op, table, mod.relpath, params, var_kw)  # type: ignore[arg-type]
+                elif isinstance(node, ast.Compare):
+                    # manual dispatch: `op == "literal"` / `op in (...)` /
+                    # `msg.get("op") ==/!= "literal"`
+                    if _is_op_lookup(node.left):
+                        for comparator in node.comparators:
+                            op = astutils.const_str(comparator)
+                            if op:
+                                add(op, "dispatch", mod.relpath, None, True)
+                            elif isinstance(comparator, (ast.Tuple, ast.List)):
+                                for elt in comparator.elts:
+                                    op = astutils.const_str(elt)
+                                    if op:
+                                        add(op, "dispatch", mod.relpath,
+                                            None, True)
+
+        # ------------------------------------------ pass 2: call sites
+        for mod in modules:
+            for node in astutils.iter_calls(mod.tree):
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                if node.func.attr in _PROXY_ATTRS:
+                    continue
+                if not _is_rpcish(node.func.value, mod.relpath):
+                    continue
+                op = node.func.attr
+                symbol = astutils.enclosing_function_name(node)
+                handlers = registry.get(op)
+                if not handlers:
+                    yield Finding(
+                        rule=self.name, path=mod.relpath, line=node.lineno,
+                        col=node.col_offset, symbol=symbol,
+                        message=f"rpc call to op {op!r}: no server registers "
+                                "this handler",
+                    )
+                    continue
+                keywords, has_star = astutils.call_keywords(node)
+                if has_star:
+                    continue
+                keys = set(keywords) - _PROTOCOL_KEYS
+                if not any(h.accepts(keys) for h in handlers):
+                    yield self._kw_finding(mod, node, symbol, op, keys, handlers)
+
+            # literal {"op": ...} messages
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Dict):
+                    continue
+                op = None
+                keys: set[str] = set()
+                dynamic = False
+                for k, _v in zip(node.keys, node.values):
+                    ks = astutils.const_str(k) if k is not None else None
+                    if ks is None:
+                        dynamic = True  # **spread or computed key
+                        continue
+                    keys.add(ks)
+                    if ks == "op":
+                        op = astutils.const_str(
+                            node.values[node.keys.index(k)]
+                        )
+                if "op" not in keys or op is None:
+                    continue
+                symbol = astutils.enclosing_function_name(node)
+                handlers = registry.get(op)
+                if not handlers:
+                    yield Finding(
+                        rule=self.name, path=mod.relpath, line=node.lineno,
+                        col=node.col_offset, symbol=symbol,
+                        message=f"message with op {op!r}: no handler table "
+                                "or dispatch arm handles it",
+                    )
+                    continue
+                if dynamic:
+                    continue
+                msg_keys = keys - _PROTOCOL_KEYS
+                if not any(h.accepts(msg_keys) for h in handlers):
+                    yield self._kw_finding(mod, node, symbol, op, msg_keys,
+                                           handlers)
+
+    def _kw_finding(self, mod, node, symbol, op, keys, handlers) -> Finding:
+        details = "; ".join(
+            f"{h.module}:{h.table} takes ({', '.join(sorted(h.params or ()))})"
+            for h in handlers
+            if h.params is not None and not h.var_kwargs
+        )
+        return Finding(
+            rule=self.name, path=mod.relpath, line=node.lineno,
+            col=node.col_offset, symbol=symbol,
+            message=(
+                f"op {op!r} sent with keywords ({', '.join(sorted(keys))}) "
+                f"that no registered handler accepts — {details or 'n/a'}"
+            ),
+        )

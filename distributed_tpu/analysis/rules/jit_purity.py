@@ -1,0 +1,273 @@
+"""jit-purity: compiled kernels must stay traced, pure, and host-sync-free.
+
+The placement/stealing/AMM kernels in ``ops/`` are the co-processor: they
+only pay off if the whole decision batch stays on device.  A ``.item()``
+or ``float(traced)`` inside a jitted function forces a device->host sync
+per call (or a ConcretizationTypeError); a captured *mutable* module
+global bakes the value at trace time and silently ignores later mutation;
+an unhashable static argument raises at every call — or worse, a mutable
+default retriggers compilation.
+
+For every function compiled with ``jax.jit`` (decorator, ``functools.partial``
+decorator, or a ``jax.jit(fn)`` wrap anywhere in the module) this rule flags:
+
+- host syncs on traced values: ``.item()``, ``.tolist()``,
+  ``.block_until_ready()``, ``jax.device_get``, and ``float()/int()/bool()``
+  or ``np.asarray/np.array`` applied to expressions rooted at a
+  **non-static** parameter;
+- loads of module-level mutable containers (dict/list/set/defaultdict/
+  deque literals) from inside the traced body;
+- ``static_argnames`` parameters with mutable (unhashable) defaults, and
+  call sites passing list/dict/set literals for them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from distributed_tpu.analysis import astutils
+from distributed_tpu.analysis.core import Finding, LintContext, Rule, register
+
+_HOST_SYNC_METHODS = ("item", "tolist", "block_until_ready")
+_CAST_BUILTINS = ("float", "int", "bool")
+_NUMPY_PULLS = ("numpy.asarray", "numpy.array", "numpy.asanyarray")
+_MUTABLE_CALLS = ("dict", "list", "set", "bytearray",
+                  "collections.defaultdict", "collections.deque")
+
+
+def _is_mutable_literal(node: ast.AST, imports: astutils.ImportMap) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return imports.resolve(node.func) in _MUTABLE_CALLS
+    return False
+
+
+def _jit_target(call: ast.Call, imports: astutils.ImportMap) -> bool:
+    return imports.resolve(call.func) in ("jax.jit", "jax.pjit")
+
+
+def _static_names(call_or_dec: ast.AST, imports: astutils.ImportMap) -> set[str]:
+    """static_argnames from a jax.jit(...) or partial(jax.jit, ...) call."""
+    out: set[str] = set()
+    if not isinstance(call_or_dec, ast.Call):
+        return out
+    for kw in call_or_dec.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                for elt in kw.value.elts:
+                    s = astutils.const_str(elt)
+                    if s:
+                        out.add(s)
+            else:
+                s = astutils.const_str(kw.value)
+                if s:
+                    out.add(s)
+    return out
+
+
+def _collect_jitted(
+    mod_tree: ast.Module, imports: astutils.ImportMap
+) -> dict[ast.FunctionDef, set[str]]:
+    """{jitted FunctionDef: static arg names}."""
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+
+    jitted: dict[ast.FunctionDef, set[str]] = {}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if imports.resolve(dec) in ("jax.jit", "jax.pjit"):
+                    jitted.setdefault(node, set())
+                elif isinstance(dec, ast.Call):
+                    target = imports.resolve(dec.func)
+                    if target in ("functools.partial", "partial") and dec.args \
+                            and imports.resolve(dec.args[0]) in ("jax.jit", "jax.pjit"):
+                        jitted.setdefault(node, set()).update(
+                            _static_names(dec, imports)
+                        )
+                    elif target in ("jax.jit", "jax.pjit"):
+                        jitted.setdefault(node, set()).update(
+                            _static_names(dec, imports)
+                        )
+        elif isinstance(node, ast.Call) and _jit_target(node, imports):
+            # jax.jit(fn, ...) wrap: mark same-module defs by name
+            if node.args and isinstance(node.args[0], ast.Name):
+                for fn in by_name.get(node.args[0].id, ()):
+                    jitted.setdefault(fn, set()).update(
+                        _static_names(node, imports)
+                    )
+    return jitted
+
+
+def _mutable_globals(mod_tree: ast.Module, imports: astutils.ImportMap) -> set[str]:
+    out: set[str] = set()
+    for stmt in mod_tree.body:
+        targets: list[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        if _is_mutable_literal(value, imports):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _local_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+    return names
+
+
+def _roots(expr: ast.AST) -> set[str]:
+    """Base names an expression is built from (a.b[c] -> {a, c})."""
+    return {
+        n.id for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "jitted kernels must not host-sync traced values, capture mutable "
+        "globals, or take unhashable static args"
+    )
+    scope = (
+        "distributed_tpu/ops/*.py",
+        "distributed_tpu/scheduler/jax_placement.py",
+    )
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for mod in ctx.modules(self):
+            astutils.add_parents(mod.tree)
+            imports = mod.imports()
+            jitted = _collect_jitted(mod.tree, imports)
+            if not jitted:
+                continue
+            mutable_globals = _mutable_globals(mod.tree, imports)
+            static_by_name = {fn.name: statics for fn, statics in jitted.items()}
+
+            for fn, statics in jitted.items():
+                yield from self._check_body(mod, imports, fn, statics,
+                                            mutable_globals)
+                # unhashable default on a static parameter
+                a = fn.args
+                params = [*a.posonlyargs, *a.args]
+                for param, default in zip(params[len(params) - len(a.defaults):],
+                                          a.defaults):
+                    if param.arg in statics and _is_mutable_literal(default, imports):
+                        yield self._finding(
+                            mod, default, fn.name,
+                            f"static arg {param.arg!r} has a mutable "
+                            "(unhashable) default",
+                        )
+                for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                    if (default is not None and param.arg in statics
+                            and _is_mutable_literal(default, imports)):
+                        yield self._finding(
+                            mod, default, fn.name,
+                            f"static arg {param.arg!r} has a mutable "
+                            "(unhashable) default",
+                        )
+
+            # call sites passing unhashable literals for static args
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    continue
+                statics = static_by_name.get(node.func.id)
+                if not statics:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in statics and _is_mutable_literal(kw.value, imports):
+                        yield self._finding(
+                            mod, kw.value,
+                            astutils.enclosing_function_name(node),
+                            f"passes an unhashable literal for static arg "
+                            f"{kw.arg!r} of jitted {node.func.id!r} "
+                            "(retriggers compilation or raises)",
+                        )
+
+    def _check_body(self, mod, imports, fn: ast.FunctionDef, statics: set[str],
+                    mutable_globals: set[str]) -> Iterator[Finding]:
+        traced_params = {
+            p.arg for p in (*fn.args.posonlyargs, *fn.args.args,
+                            *fn.args.kwonlyargs)
+        } - statics
+        locals_ = _local_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = imports.resolve(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_METHODS):
+                    yield self._finding(
+                        mod, node, fn.name,
+                        f".{node.func.attr}() forces a device->host sync "
+                        "inside a jitted function",
+                    )
+                elif target == "jax.device_get":
+                    yield self._finding(
+                        mod, node, fn.name,
+                        "jax.device_get inside a jitted function is a "
+                        "host sync",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _CAST_BUILTINS
+                    and node.args
+                    and _roots(node.args[0]) & traced_params
+                ):
+                    yield self._finding(
+                        mod, node, fn.name,
+                        f"{node.func.id}() on a traced value concretizes it "
+                        "(host sync / ConcretizationTypeError); use jnp casts",
+                    )
+                elif (
+                    target in _NUMPY_PULLS
+                    and node.args
+                    and _roots(node.args[0]) & traced_params
+                ):
+                    yield self._finding(
+                        mod, node, fn.name,
+                        f"{target} on a traced value pulls it to host; "
+                        "use jnp.asarray",
+                    )
+            elif (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutable_globals
+                and node.id not in locals_
+            ):
+                yield self._finding(
+                    mod, node, fn.name,
+                    f"captures mutable module global {node.id!r}; its value "
+                    "is baked at trace time — pass it as an argument",
+                )
+
+    def _finding(self, mod, node: ast.AST, symbol: str, message: str) -> Finding:
+        return Finding(
+            rule=self.name, path=mod.relpath, line=node.lineno,
+            col=node.col_offset, message=message, symbol=symbol,
+        )

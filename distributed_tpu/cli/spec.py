@@ -30,6 +30,7 @@ async def run(args: argparse.Namespace) -> int:
     from distributed_tpu.utils.misc import import_term
 
     if args.spec_file:
+        # graft-lint: allow[blocking-in-async] CLI startup, nothing else on the loop yet
         with open(args.spec_file) as f:
             spec = json.load(f)
     elif args.spec:

@@ -67,6 +67,14 @@ class FutureState:
         self.exception = asyncio.CancelledError()
         self.event.set()
 
+    def retry(self) -> None:
+        """Scheduler reran an erred/lost key: wait for the new attempt."""
+        self.status = "pending"
+        self.exception = None
+        self.traceback = None
+        self.traceback_text = ""
+        self.event.clear()
+
 
 class Future:
     """A remote result (reference client.py:174)."""
@@ -352,6 +360,15 @@ class Client:
                                 expected is missing or st is expected
                             ):
                                 st.cancel()
+                    elif op == "task-retried":
+                        # another client's retry reran this key: drop our
+                        # terminal view and wait for the fresh attempt.
+                        # The initiating client reset its state in
+                        # retry() already; anything non-terminal (e.g. a
+                        # resubmission racing this report) is left alone
+                        st = self.futures.get(msg.get("key"))
+                        if st is not None and st.status in ("error", "lost"):
+                            st.retry()
                     elif op == "pubsub-msg":
                         for sub in self._pubsub_subs.get(msg.get("name"), ()):
                             sub._put(msg.get("msg"))
@@ -635,7 +652,20 @@ class Client:
         st = self.futures.get(future.key)
         if st is None:
             raise asyncio.CancelledError(future.key)
-        await asyncio.wait_for(st.event.wait(), timeout)
+        # one deadline for the WHOLE wait: re-waits after a task-retried
+        # reset must not re-arm the user's timeout
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        remaining = (
+            (lambda: None) if deadline is None
+            else (lambda: max(deadline - loop.time(), 0.001))
+        )
+        await asyncio.wait_for(st.event.wait(), remaining())
+        while st.status == "pending":
+            # woken by a terminal state that a task-retried report then
+            # reset before this coroutine resumed: the key is being
+            # recomputed — wait for the NEW attempt, don't gather it
+            await asyncio.wait_for(st.event.wait(), remaining())
         if st.status == "error":
             assert st.exception is not None
             raise st.exception
@@ -675,6 +705,10 @@ class Client:
                     continue
                 raise asyncio.CancelledError(f.key)
             await st.event.wait()
+            while st.status == "pending":
+                # set_error raced a task-retried reset (see _result):
+                # re-wait for the new attempt's completion
+                await st.event.wait()
             if st.status == "error" and errors == "raise":
                 assert st.exception is not None
                 raise st.exception
@@ -778,9 +812,7 @@ class Client:
         for f in futures:
             st = self.futures.get(f.key)
             if st is not None:
-                st.status = "pending"
-                st.event.clear()
-                st.exception = None
+                st.retry()
             keys.append(f.key)
         assert self.scheduler is not None
         await self.scheduler.retry(keys=keys, client=self.id)
@@ -913,8 +945,11 @@ class Client:
         if filename:
             import json
 
-            with open(filename, "w") as f:
-                json.dump(state, f, default=str, indent=1)
+            def _write() -> None:  # dump can be huge: keep it off-loop
+                with open(filename, "w") as f:
+                    json.dump(state, f, default=str, indent=1)
+
+            await asyncio.get_running_loop().run_in_executor(None, _write)
         return state
 
     async def memory_trace_start(self, workers: list[str] | None = None) -> dict:
@@ -1055,9 +1090,24 @@ class Client:
         """Self-contained HTML snapshot (reference scheduler.py:8077)."""
         assert self.scheduler is not None
         html = await self.scheduler.performance_report_html()
-        with open(filename, "w") as f:
-            f.write(html)
+
+        def _write() -> None:
+            with open(filename, "w") as f:
+                f.write(html)
+
+        await asyncio.get_running_loop().run_in_executor(None, _write)
         return filename
+
+    async def eventstream_start(self) -> str:
+        """Opt into per-task completion events; returns the topic name.
+        The reference is tied to this client: it is released on
+        disconnect even if :meth:`eventstream_stop` is never called."""
+        assert self.scheduler is not None
+        return await self.scheduler.eventstream_start(client=self.id)
+
+    async def eventstream_stop(self) -> None:
+        assert self.scheduler is not None
+        await self.scheduler.eventstream_stop(client=self.id)
 
     async def profile(self, workers: list[str] | None = None,
                       start: float | None = None) -> dict:

@@ -76,6 +76,7 @@ class TCP(Comm):
                 raise
             from distributed_tpu.utils import format_exception
 
+            # graft-lint: allow[handler-parity] comm-layer sentinel surfaced to the reader, not a dispatched op
             frames = dumps({"op": "protocol-error", "error": format_exception()})
         lengths = [memoryview(f).nbytes for f in frames]
         header = _u64.pack(len(frames)) + struct.pack(f"<{len(frames)}Q", *lengths)

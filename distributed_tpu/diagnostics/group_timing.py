@@ -14,7 +14,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any
 
-from distributed_tpu.utils.misc import key_split, time
+from distributed_tpu.utils.misc import key_split, time, wall_clock
 
 
 class GroupTimingPlugin:
@@ -22,8 +22,6 @@ class GroupTimingPlugin:
 
     def __init__(self, scheduler: Any, bucket_s: float = 1.0,
                  max_buckets: int = 3600):
-        import time as _wall
-
         self.scheduler = scheduler
         self.bucket_s = bucket_s
         self.max_buckets = max_buckets
@@ -33,7 +31,7 @@ class GroupTimingPlugin:
         # across hosts, so only their DELTAS (durations) are meaningful
         # here.  t0_wall anchors the series to wall clock for display.
         self.t0 = time()
-        self.t0_wall = _wall.time()
+        self.t0_wall = wall_clock()
         # bucket index -> {prefix: compute seconds}
         self.buckets: dict[int, dict[str, float]] = {}
         scheduler.state.plugins[self.name] = self

@@ -125,6 +125,7 @@ class HTTPServer:
         finally:
             try:
                 writer.close()
+            # graft-lint: allow[swallowed-exceptions] best-effort socket close after reply
             except Exception:
                 pass
 

@@ -197,6 +197,14 @@ class Scheduler(Server):
         )
         self.spans = SpansSchedulerExtension(self)
         self._topic_subscribers: dict[str, set[str]] = {}
+        # eventstream refcounting: total starts minus stops, plus a
+        # per-client breakdown so a consumer that crashes without
+        # calling eventstream_stop releases its references when its
+        # comm closes (remove-client path) instead of pinning the
+        # per-completion plugin forever
+        self._eventstream_refs = 0
+        self._eventstream_clients: dict[str, int] = {}
+        self._eventstream_anon = 0  # starts not tied to any client
         self.state.events_subscriber_hook = self._fan_out_event
         self.worker_plugins: dict[str, Any] = {}  # shipped to joining workers
         self._nanny_plugins: dict[str, Any] = {}  # shipped to joining nannies
@@ -474,7 +482,7 @@ class Scheduler(Server):
     async def heartbeat_worker(
         self, address: str = "", now: float = 0.0, metrics: dict | None = None,
         fine_metrics: list | None = None, executing_status: str = "",
-        **kwargs: Any,
+        status_seq: int = -1, **kwargs: Any,
     ) -> dict:
         ws = self.state.workers.get(address)
         if ws is None:
@@ -490,18 +498,23 @@ class Scheduler(Server):
         # pins the paused worker's tasks out of stealing forever.
         # A heartbeat that raced a fresher stream-delivered change must
         # NOT win (its snapshot predates the RPC; the spurious paused
-        # flip un-homes tasks irreversibly): a recent stream change
-        # suppresses reconciliation — a REAL persistent mismatch is
-        # re-reported by the next heartbeat once the window passes.
-        if (
-            executing_status
-            and executing_status != ws.status
-            and time() - ws.status_changed_at > 1.0
-        ):
-            self.handle_worker_status_change(
-                status=executing_status, worker=address,
-                stimulus_id=seq_name("heartbeat-status"),
-            )
+        # flip un-homes tasks irreversibly): the worker stamps every
+        # status flip AND every heartbeat with a monotonic status_seq,
+        # and the heartbeat's view is applied only when provably at
+        # least as new as the last flip this scheduler has seen.  (A
+        # pre-seq worker — status_seq < 0 — falls back to a wall-clock
+        # quiet window, the old racy heuristic.)
+        if executing_status and executing_status != ws.status:
+            if (
+                status_seq >= ws.status_seq
+                if status_seq >= 0
+                else time() - ws.status_changed_at > 1.0
+            ):
+                self.handle_worker_status_change(
+                    status=executing_status, worker=address,
+                    stimulus_id=seq_name("heartbeat-status"),
+                    status_seq=status_seq,
+                )
         return {"status": "OK", "time": time(),
                 "heartbeat-interval": self.heartbeat_interval()}
 
@@ -564,6 +577,12 @@ class Scheduler(Server):
             self.client_comms.pop(client, None)
             for subs in self._topic_subscribers.values():
                 subs.discard(client)
+            # a consumer that died without eventstream_stop must not pin
+            # the per-completion plugin forever: drop every reference it
+            # still holds now that its comm is gone
+            held = self._eventstream_clients.pop(client, 0)
+            if held:
+                self._release_eventstream_refs(held)
             stimulus_id = seq_name("remove-client")
             client_msgs, worker_msgs = self.state.remove_client_state(
                 client, stimulus_id
@@ -787,10 +806,17 @@ class Scheduler(Server):
         self.log_event(topic or "all", {"worker": worker, "msg": msg})
 
     def handle_worker_status_change(self, status: str = "", worker: str = "",
-                                    stimulus_id: str = "", **kw: Any) -> None:
+                                    stimulus_id: str = "",
+                                    status_seq: int = -1, **kw: Any) -> None:
         ws = self.state.workers.get(worker)
         if ws is None:
             return
+        if status_seq >= 0:
+            if status_seq < ws.status_seq:
+                # stale stream message ordered behind a fresher flip
+                # (possible after a heartbeat-applied reconciliation)
+                return
+            ws.status_seq = status_seq
         ws.status = status
         ws.status_changed_at = time()
         if status == "paused":
@@ -826,17 +852,38 @@ class Scheduler(Server):
 
     async def gather(self, keys: Iterable[Key] = (), **kwargs: Any) -> dict:
         """Collect data from workers for a client (reference scheduler.py:6150)."""
-        keys = list(keys)
-        who_has = {}
-        for key in keys:
-            ts = self.state.tasks.get(key)
-            who_has[key] = [ws.address for ws in ts.who_has] if ts else []
-        data, missing, failed = await gather_from_workers(who_has, rpc=self.rpc)
-        if missing:
-            logger.warning("gather couldn't find %s", sorted(missing))
+        data: dict[Key, Any] = {}
+        missing: set[Key] = set()
+        busy: set[Key] = set()
+        failed: list[str] = []
+        pending: list[Key] = list(keys)
+        for _attempt in range(3):
+            who_has = {}
+            for key in pending:
+                ts = self.state.tasks.get(key)
+                who_has[key] = [ws.address for ws in ts.who_has] if ts else []
+            d, m, busy, f = await gather_from_workers(who_has, rpc=self.rpc)
+            data.update(d)
+            missing |= m
+            failed.extend(w for w in f if w not in failed)
+            if not busy:
+                break
+            # busy holders still HAVE the data: refresh who_has from
+            # current state (the key may have gained replicas or moved)
+            # and retry just those keys instead of reporting data that
+            # exists as lost (ADVICE.md #1)
+            logger.info("gather retrying %d busy key(s)", len(busy))
+            pending = sorted(busy)
+        if missing or busy:
+            if missing:
+                logger.warning("gather couldn't find %s", sorted(missing))
+            if busy:
+                logger.warning("gather gave up on busy holders of %s",
+                               sorted(busy))
             return {
                 "status": "error",
-                "keys": sorted(missing),
+                "keys": sorted(missing | busy),
+                "busy": sorted(busy),
                 "workers": failed,
             }
         return {
@@ -1612,8 +1659,9 @@ class Scheduler(Server):
             adv = parse_host_port(self.address.split("://", 1)[-1])[0]
             if adv and adv not in ("0.0.0.0", ""):
                 host = adv
+        # graft-lint: allow[swallowed-exceptions] inproc:// has no host:port; keep the bind host
         except Exception:
-            pass  # inproc:// etc: keep the bind host
+            pass
         return f"http://{host}:{port}"
 
     def get_computations(self) -> list[dict]:
@@ -1630,26 +1678,49 @@ class Scheduler(Server):
             for comp in self.state.computations
         ]
 
-    def eventstream_start(self) -> str:
+    def eventstream_start(self, client: str = "") -> str:
         """Install the opt-in per-task event publisher (reference
         diagnostics/eventstream.py:12); consumers subscribe to the
         returned topic.  Opt-in because it costs a ring-buffer append
         plus subscriber fan-out on EVERY task completion.  Refcounted:
         the plugin is global, so one consumer's stop must not kill the
-        stream for the others."""
+        stream for the others.  Passing ``client`` ties the reference to
+        that client's lifetime — released automatically when the client
+        disconnects (anonymous references require an explicit stop)."""
         from distributed_tpu.diagnostics.eventstream import EventStreamPlugin
 
-        self._eventstream_refs = getattr(self, "_eventstream_refs", 0) + 1
+        self._eventstream_refs += 1
+        if client:
+            self._eventstream_clients[client] = (
+                self._eventstream_clients.get(client, 0) + 1
+            )
+        else:
+            self._eventstream_anon += 1
         if EventStreamPlugin.name not in self.state.plugins:
             EventStreamPlugin(self)
         return EventStreamPlugin.topic
 
-    def eventstream_stop(self) -> None:
+    def eventstream_stop(self, client: str = "") -> None:
+        # an unmatched/double stop (tied OR anonymous) must not steal a
+        # reference another live consumer still holds
+        if client:
+            held = self._eventstream_clients.get(client, 0)
+            if not held:
+                return
+            if held == 1:
+                del self._eventstream_clients[client]
+            else:
+                self._eventstream_clients[client] = held - 1
+        else:
+            if not self._eventstream_anon:
+                return
+            self._eventstream_anon -= 1
+        self._release_eventstream_refs(1)
+
+    def _release_eventstream_refs(self, n: int) -> None:
         from distributed_tpu.diagnostics.eventstream import EventStreamPlugin
 
-        self._eventstream_refs = max(
-            getattr(self, "_eventstream_refs", 0) - 1, 0
-        )
+        self._eventstream_refs = max(self._eventstream_refs - n, 0)
         if not self._eventstream_refs:
             self.state.plugins.pop(EventStreamPlugin.name, None)
 

@@ -346,6 +346,7 @@ class WorkerState:
         "_network_occ",
         "last_seen",
         "status_changed_at",
+        "status_seq",
         "metrics",
         "memory_unmanaged_old",
         "bandwidth",
@@ -381,6 +382,10 @@ class WorkerState:
         self._network_occ = 0  # bytes pending transfer to this worker
         self.last_seen = time()
         self.status_changed_at = 0.0  # last stream-delivered status flip
+        # worker-stamped monotonic sequence of the last applied status
+        # flip: a heartbeat's status view is reconciled only when its
+        # seq proves it is at least as new (see heartbeat_worker)
+        self.status_seq = 0
         self.metrics: dict = {}
         self.memory_unmanaged_old = 0
         self.bandwidth = float(config.get("scheduler.bandwidth"))

@@ -21,18 +21,30 @@ logger = logging.getLogger("distributed_tpu.utils.comm")
 
 from distributed_tpu.protocol.serialize import unwrap as _unwrap
 
+# busy-holder retry: exponential backoff from BASE capped at MAX, for at
+# most ROUNDS_MAX all-busy rounds.  Module constants so tests can shrink
+# the waits.
+BUSY_BACKOFF_BASE = 0.05
+BUSY_BACKOFF_MAX = 5.0
+BUSY_ROUNDS_MAX = 12
+
 
 async def gather_from_workers(
     who_has: dict[str, list[str]],
     rpc: Callable,
-) -> tuple[dict[str, Any], set[str], list[str]]:
+) -> tuple[dict[str, Any], set[str], set[str], list[str]]:
     """Fetch ``{key: [workers]}`` from the cluster (reference utils_comm.py:56).
 
-    Returns ``(data, missing_keys, failed_workers)``.  Tries alternative
-    holders for a key when a worker is unreachable or no longer has it.
+    Returns ``(data, missing_keys, busy_keys, failed_workers)``.  Tries
+    alternative holders for a key when a worker is unreachable or no
+    longer has it.  ``busy_keys`` are held by live-but-saturated workers
+    after the round budget ran out: the data EXISTS — callers refresh
+    ``who_has`` and retry at their level (Scheduler.gather does) instead
+    of surfacing a data-loss error for it.
     """
     data: dict[str, Any] = {}
     missing: set[str] = set()
+    busy: set[str] = set()
     failed_workers: set[str] = set()
     busy_rounds = 0
     remaining: dict[str, list[str]] = {
@@ -96,18 +108,23 @@ async def gather_from_workers(
                         remaining.pop(k, None)
         if any_busy and not progressed:
             busy_rounds += 1
-            if busy_rounds > 12:
-                # ~30s of capped exponential backoff exhausted: report
-                # the still-remaining keys missing instead of hammering
-                # an overloaded holder forever — callers (scheduler
-                # gather retry, worker missing->refresh) have their own
-                # higher-level recovery
-                missing.update(remaining)
+            if busy_rounds > BUSY_ROUNDS_MAX:
+                # ~30s of capped exponential backoff exhausted.  The
+                # holders are alive but saturated (or dying and lying):
+                # hand the keys back as BUSY — distinct from missing,
+                # because the data exists — so the caller can refresh
+                # who_has and retry at its level.  Retrying here forever
+                # (the reference's behavior) wedges this coroutine when
+                # a closing worker keeps answering busy (ADVICE.md #1 /
+                # chaos soak).
+                busy.update(remaining)
                 break
-            await asyncio.sleep(min(0.05 * 2 ** busy_rounds, 5.0))
+            await asyncio.sleep(
+                min(BUSY_BACKOFF_BASE * 2**busy_rounds, BUSY_BACKOFF_MAX)
+            )
         else:
             busy_rounds = 0
-    return data, missing, sorted(failed_workers)
+    return data, missing, busy, sorted(failed_workers)
 
 
 async def scatter_to_workers(

@@ -121,6 +121,9 @@ class WorkerMemoryManager:
 
         worker = self.worker
         stimulus_id = seq_name("memory-monitor")
+        # the seq is bumped BEFORE either send path: the stream message
+        # and every later heartbeat carry the same ordering stamp
+        worker._status_seq += 1
         worker.handle_stimulus(
             PauseEvent(stimulus_id=stimulus_id)
             if status == "paused"
@@ -129,10 +132,14 @@ class WorkerMemoryManager:
         try:
             worker.batched_stream.send(
                 {"op": "worker-status-change", "status": status,
+                 "status_seq": worker._status_seq,
                  "stimulus_id": stimulus_id}
             )
         except Exception:
-            pass
+            # the batched stream may not exist yet at startup — the pause
+            # still applies locally and the next heartbeat reconciles
+            logger.debug("status-change send failed (stream not up yet)",
+                         exc_info=True)
 
 
 class NannyMemoryManager:

@@ -185,6 +185,11 @@ class Worker(Server):
         self.heartbeat_interval = (
             heartbeat_interval if heartbeat_interval is not None else 1.0
         )
+        # monotonic count of local pause/unpause flips; stamped onto
+        # worker-status-change messages and every heartbeat so the
+        # scheduler can order a delayed heartbeat's status view against
+        # stream-delivered flips (see Scheduler.heartbeat_worker)
+        self._status_seq = 0
         self.plugins: dict[str, Any] = {}
         self._pubsub_subs: dict[str, list] = {}
         self._async_instructions: set[asyncio.Task] = set()
@@ -421,6 +426,7 @@ class Worker(Server):
                 # frees its tasks for stealing
                 executing_status="paused" if not self.state.running
                 else "running",
+                status_seq=self._status_seq,
             )
             if resp.get("status") == "missing":
                 # scheduler forgot us (e.g. after its restart): re-register
@@ -552,12 +558,17 @@ class Worker(Server):
         who_has = who_has or {}
         from distributed_tpu.utils.comm import gather_from_workers
 
-        data, missing, _ = await gather_from_workers(who_has, rpc=self.rpc)
+        data, missing, busy, _ = await gather_from_workers(who_has, rpc=self.rpc)
         self.handle_stimulus(
             UpdateDataEvent(stimulus_id=seq_name("gather"), data=data)
         )
-        if missing:
-            return {"status": "partial-fail", "keys": list(missing)}
+        if missing or busy:
+            # busy keys exist on their (saturated) holders — reported
+            # separately so callers can retry them without a who_has
+            # refresh
+            return {"status": "partial-fail",
+                    "keys": sorted(missing | busy),
+                    "busy": sorted(busy)}
         return {"status": "OK"}
 
     async def run_function(
@@ -789,6 +800,11 @@ class Worker(Server):
             sub._put(msg)
 
     def _stream_status_change(self, status: str = "", stimulus_id: str = "") -> None:
+        if status in ("paused", "running"):
+            # EVERY local flip bumps the seq, whatever initiated it —
+            # heartbeats snapshotted before this flip must order behind
+            # it (see Scheduler.heartbeat_worker)
+            self._status_seq += 1
         if status == "paused":
             self._enqueue_stream_event(PauseEvent(stimulus_id=stimulus_id))
         elif status == "running":
@@ -1016,9 +1032,38 @@ class Worker(Server):
                         ))
                 return out
 
-            results = await asyncio.get_running_loop().run_in_executor(
-                self.executor, _run_batch
-            )
+            batch_start = time()
+            try:
+                results = await asyncio.get_running_loop().run_in_executor(
+                    self.executor, _run_batch
+                )
+            except BaseException as e:  # noqa: B036 - mirror _execute
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+                if isinstance(e, asyncio.CancelledError) and self.status in (
+                    Status.closing, Status.closed, Status.failed
+                ):
+                    # worker shutdown cancelled the batch: propagate,
+                    # exactly like _execute (no task-erred during close)
+                    raise
+                # a CancelledError outside shutdown (or any executor
+                # failure) must not wedge the whole batch in "executing"
+                # with no completion event: emit a failure per task so
+                # the scheduler can retry them elsewhere.  The executor
+                # thread may still be running the batch — its results
+                # are dropped, which is safe (transitions ignore
+                # completions for released tasks).
+                stop = time()
+                e2 = truncate_exception(e)
+                tb_text = format_exception(e)
+                for key, sid, _ts, _prefix, _ctx, _fn, _a, _kw in calls:
+                    events.append(ExecuteFailureEvent(
+                        stimulus_id=sid, key=key, exception=e2,
+                        traceback=None, exception_text=repr(e2),
+                        traceback_text=tb_text,
+                        start=batch_start, stop=stop,
+                    ))
+                results = []
             for key, sid, ts, kind, value, start, stop in results:
                 if kind == "ok":
                     self.digest_metric("compute-duration", stop - start)

@@ -15,11 +15,20 @@ import os
 # supported mechanism on jax 0.9; the XLA_FLAGS host-device-count is ignored).
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# jax < 0.5 has no jax_num_cpu_devices option; there the XLA flag is the
+# only mechanism and IS honored (it became a no-op later).  Set both.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.5
+    pass
 
 import asyncio  # noqa: E402
 import functools  # noqa: E402

@@ -1,0 +1,515 @@
+"""graft-lint tests: every rule proven on seeded-violation fixtures, the
+pragma/baseline suppression paths, and the CLI over the real repo (the
+tier-1 CI wiring — a clean tree is an acceptance criterion, so this file
+IS the lint gate)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from distributed_tpu.analysis.core import all_rules, run_lint
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def make_repo(tmp_path: Path, files: dict[str, str]) -> Path:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def findings_for(tmp_path, files, rule):
+    root = make_repo(tmp_path, files)
+    result = run_lint(root, rule_names=[rule])
+    assert not result.errors, result.errors
+    return result.findings
+
+
+# --------------------------------------------------------------- registry
+
+
+def test_registry_has_all_contract_rules():
+    rules = all_rules()
+    assert set(rules) >= {
+        "sans-io", "monotonic-time", "blocking-in-async", "handler-parity",
+        "jit-purity", "swallowed-exceptions",
+    }
+    assert len(rules) >= 6
+    for rule in rules.values():
+        assert rule.description and rule.scope
+
+
+# ---------------------------------------------------------------- sans-io
+
+
+def test_sans_io_fires_on_seeded_violations(tmp_path):
+    src = """
+        import asyncio
+        from distributed_tpu.comm.core import connect
+
+        async def pull(self):
+            await asyncio.sleep(0)
+
+        def load(path):
+            return open(path).read()
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src}, "sans-io"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "imports 'asyncio'" in msgs
+    assert "imports from 'distributed_tpu.comm'" in msgs
+    assert "async/await" in msgs
+    assert "open" in msgs
+    assert len(found) >= 4
+
+
+def test_sans_io_clean_engine_passes(tmp_path):
+    src = """
+        from collections import deque
+
+        def transition(state, key):
+            return {"released": "waiting"}.get(state)
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src}, "sans-io"
+    )
+
+
+def test_sans_io_ignores_out_of_scope_files(tmp_path):
+    # the same IO is legal outside the transition engines
+    src = "import asyncio\n"
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/server.py": src}, "sans-io"
+    )
+
+
+# --------------------------------------------------------- monotonic-time
+
+
+def test_monotonic_time_fires_including_aliases(tmp_path):
+    src = """
+        import time
+        import time as _t
+        from time import sleep
+
+        def wait_for_worker(deadline):
+            t0 = time.time()
+            _t.sleep(0.1)
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/ttl.py": src}, "monotonic-time"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "time.time()" in msgs
+    assert "time.sleep()" in msgs
+    assert "imports wall-clock" in msgs
+    assert len(found) == 3
+
+
+def test_monotonic_time_allows_sanctioned_clocks(tmp_path):
+    src = """
+        from time import monotonic, perf_counter
+
+        from distributed_tpu.utils.misc import time, wall_clock
+
+        def stamp():
+            return time(), wall_clock(), monotonic(), perf_counter()
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/ttl.py": src}, "monotonic-time"
+    )
+
+
+# ------------------------------------------------------ blocking-in-async
+
+
+def test_blocking_in_async_fires(tmp_path):
+    src = """
+        import subprocess
+        import time
+
+        async def handler(self, path):
+            time.sleep(1)
+            subprocess.run(["ls"])
+            with open(path) as f:
+                f.read()
+            self._lock.acquire()
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "blocking-in-async"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "time.sleep" in msgs
+    assert "subprocess.run" in msgs
+    assert "sync file IO" in msgs
+    assert "lock.acquire" in msgs
+    assert len(found) == 4
+
+
+def test_blocking_in_async_exempts_executor_targets_and_sync_defs(tmp_path):
+    src = """
+        import asyncio
+        import time
+
+        def plain(path):
+            time.sleep(1)  # sync helper: not loop code
+            return open(path).read()
+
+        async def handler(loop, path):
+            def _work():
+                time.sleep(1)  # executor target
+                with open(path) as f:
+                    return f.read()
+
+            await asyncio.sleep(0.1)
+            return await loop.run_in_executor(None, _work)
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "blocking-in-async"
+    )
+
+
+# --------------------------------------------------------- handler-parity
+
+
+def test_handler_parity_unknown_rpc_op(tmp_path):
+    src = """
+        class Worker:
+            def __init__(self):
+                handlers = {"get_data": self.get_data}
+
+            def get_data(self, keys=()):
+                return keys
+
+            async def fetch(self, addr):
+                return await self.rpc(addr).get_dta(keys=[])
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    assert len(found) == 1
+    assert "get_dta" in found[0].message and "no server registers" in found[0].message
+
+
+def test_handler_parity_keyword_mismatch(tmp_path):
+    src = """
+        class Worker:
+            def __init__(self):
+                handlers = {"get_data": self.get_data}
+
+            def get_data(self, comm, keys=()):
+                return keys
+
+            async def fetch(self, addr):
+                return await self.rpc(addr).get_data(keys=[], who="me")
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    assert len(found) == 1
+    assert "who" in found[0].message
+
+
+def test_handler_parity_accepts_update_registration_and_stream_msgs(tmp_path):
+    src = """
+        class Ext:
+            def __init__(self, scheduler):
+                scheduler.stream_handlers.update(
+                    {"shuffle-ping": self.ping}
+                )
+
+            def ping(self, id=None, stimulus_id=None):
+                return id
+
+        class Worker:
+            def tell(self):
+                self.batched_stream.send(
+                    {"op": "shuffle-ping", "id": 1, "stimulus_id": "s"}
+                )
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/shuffle/ext.py": src}, "handler-parity"
+    )
+
+
+def test_handler_parity_stream_msg_keyword_not_accepted(tmp_path):
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+
+            def handle_done(self, key=None):
+                return key
+
+            def report(self):
+                self.batched_stream.send(
+                    {"op": "task-done", "key": "k", "nbytes": 3}
+                )
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    assert len(found) == 1
+    assert "nbytes" in found[0].message
+
+
+def test_handler_parity_learns_manual_dispatch_arms(tmp_path):
+    src = """
+        def consume(q):
+            msg = q.get()
+            if msg.get("op") != "started":
+                raise RuntimeError(msg)
+
+        def produce(q, addr):
+            q.put({"op": "started", "address": addr})
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/boot.py": src}, "handler-parity"
+    )
+
+
+# ------------------------------------------------------------- jit-purity
+
+
+def test_jit_purity_fires_on_host_syncs_and_captures(tmp_path):
+    src = """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _CACHE = {}
+
+        @functools.partial(jax.jit, static_argnames=("K",))
+        def kern(x, K):
+            n = float(x)
+            k = float(K)  # static arg: concrete python value, fine
+            v = x.item()
+            h = np.asarray(x)
+            return jnp.sum(x) + len(_CACHE)
+
+        def call(x):
+            return kern(x, K=[1, 2])
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/ops/kern.py": src}, "jit-purity"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "float() on a traced value" in msgs
+    assert ".item() forces" in msgs
+    assert "numpy.asarray on a traced value" in msgs
+    assert "mutable module global '_CACHE'" in msgs
+    assert "unhashable literal for static arg 'K'" in msgs
+    assert len(found) == 5  # float(K) must NOT be flagged
+
+
+def test_jit_purity_flags_mutable_static_default_and_jit_wrap(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def make(n):
+            def shard(x, meta=[]):
+                return jnp.sum(x) + meta.count(0) + x.tolist()[0]
+
+            return jax.jit(shard, static_argnames=("meta",))
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/ops/wrap.py": src}, "jit-purity"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "mutable (unhashable) default" in msgs
+    assert ".tolist()" in msgs
+    assert len(found) == 2
+
+
+def test_jit_purity_clean_kernel_passes(tmp_path):
+    src = """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        _EPS = 1e-6  # immutable scalar global: fine to close over
+
+        @functools.partial(jax.jit, static_argnames=("K",))
+        def kern(costs, K):
+            top = jax.lax.top_k(costs, K)[0]
+            return jnp.where(top > _EPS, top, 0.0)
+
+        def host_wrapper(costs_host, K):
+            import numpy as np
+
+            return np.asarray(kern(jnp.asarray(costs_host), K=int(K)))
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/ops/kern.py": src}, "jit-purity"
+    )
+
+
+# ------------------------------------------------- swallowed-exceptions
+
+
+def test_swallowed_exceptions_fires(tmp_path):
+    src = """
+        def dispatch(handler):
+            try:
+                handler()
+            except Exception:
+                pass
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/rpc/disp.py": src}, "swallowed-exceptions"
+    )
+    assert len(found) == 1
+
+
+def test_swallowed_exceptions_allows_logged_or_narrow(tmp_path):
+    src = """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def dispatch(handler):
+            try:
+                handler()
+            except KeyError:
+                pass  # narrow: deliberate
+            except Exception:
+                logger.exception("handler failed")
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/rpc/disp.py": src}, "swallowed-exceptions"
+    )
+
+
+# ------------------------------------------------------ pragma / baseline
+
+
+def test_inline_pragma_suppresses_with_reason(tmp_path):
+    src = """
+        def dispatch(handler):
+            try:
+                handler()
+            # graft-lint: allow[swallowed-exceptions] probe path, outcome irrelevant
+            except Exception:
+                pass
+    """
+    root = make_repo(tmp_path, {"distributed_tpu/rpc/disp.py": src})
+    result = run_lint(root, rule_names=["swallowed-exceptions"])
+    assert not result.findings
+    assert result.suppressed == 1
+
+
+def test_inline_pragma_without_reason_does_not_suppress(tmp_path):
+    src = """
+        def dispatch(handler):
+            try:
+                handler()
+            # graft-lint: allow[swallowed-exceptions]
+            except Exception:
+                pass
+    """
+    root = make_repo(tmp_path, {"distributed_tpu/rpc/disp.py": src})
+    result = run_lint(root, rule_names=["swallowed-exceptions"])
+    assert len(result.findings) == 1
+
+
+def test_baseline_entry_suppresses_and_requires_reason(tmp_path):
+    src = """
+        def dispatch(handler):
+            try:
+                handler()
+            except Exception:
+                pass
+    """
+    root = make_repo(tmp_path, {"distributed_tpu/rpc/disp.py": src})
+    (root / "graft-lint-baseline.toml").write_text(textwrap.dedent("""
+        [[allow]]
+        rule = "swallowed-exceptions"
+        path = "distributed_tpu/rpc/disp.py"
+        symbol = "dispatch"
+        reason = "probe path, outcome irrelevant"
+    """))
+    result = run_lint(root, rule_names=["swallowed-exceptions"])
+    assert not result.findings and result.suppressed == 1
+
+    # an entry with no reason is itself an error, and never suppresses
+    (root / "graft-lint-baseline.toml").write_text(textwrap.dedent("""
+        [[allow]]
+        rule = "swallowed-exceptions"
+        path = "distributed_tpu/rpc/disp.py"
+    """))
+    result = run_lint(root, rule_names=["swallowed-exceptions"])
+    assert len(result.findings) == 1
+    assert any("no reason" in e for e in result.errors)
+    assert result.exit_code == 1
+
+
+def test_baseline_stale_entries_are_reported(tmp_path):
+    root = make_repo(tmp_path, {"distributed_tpu/rpc/disp.py": "x = 1\n"})
+    (root / "graft-lint-baseline.toml").write_text(textwrap.dedent("""
+        [[allow]]
+        rule = "swallowed-exceptions"
+        path = "distributed_tpu/rpc/gone.py"
+        reason = "was real once"
+    """))
+    result = run_lint(root)
+    assert result.stale_baseline
+
+
+def test_config_scoping_and_disable(tmp_path):
+    src = "import asyncio\n"
+    root = make_repo(tmp_path, {"distributed_tpu/graph/order.py": src})
+    assert run_lint(root, rule_names=["sans-io"]).findings
+    (root / "graft-lint.toml").write_text(textwrap.dedent("""
+        [rules.sans-io]
+        exclude = ["distributed_tpu/graph/order.py"]
+    """))
+    assert not run_lint(root, rule_names=["sans-io"]).findings
+    (root / "graft-lint.toml").write_text(textwrap.dedent("""
+        [rules.sans-io]
+        enabled = false
+    """))
+    assert not run_lint(root, rule_names=["sans-io"]).findings
+
+
+# ------------------------------------------------------- CLI / repo gate
+
+
+def test_cli_json_clean_on_this_repo():
+    """The tier-1 lint gate: the real tree must be graft-lint clean.
+
+    Runs the module CLI exactly as CI does; any new violation (or a
+    broken/stale-reasonless baseline entry) fails this test."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.analysis", "--format", "json",
+         "--root", str(REPO_ROOT)],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["findings"] == []
+    assert report["errors"] == []
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, timeout=60, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0
+    for name in ("sans-io", "monotonic-time", "blocking-in-async",
+                 "handler-parity", "jit-purity", "swallowed-exceptions"):
+        assert name in proc.stdout

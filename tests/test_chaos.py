@@ -71,21 +71,37 @@ async def _chaos_soak(n_tasks: int, protocol: str, seed: int = 42,
                                  key=f"chaos-sum-{i}")
                         for i in range(0, n_tasks, 50)
                     ]
+                    # generous budget: this soak takes ~75s alone but ~3x
+                    # that on this single-core box when the whole suite's
+                    # collected modules (jax backends, compiled ops) are
+                    # resident — the timeout guards against a HANG, not
+                    # against slowness
                     total = await asyncio.wait_for(
-                        c.gather(c.submit(_tree_sum, sums)), 240
+                        c.gather(c.submit(_tree_sum, sums)), 420
                     )
                 finally:
                     stop.set()
                     await chaos_task
                 assert total == sum(range(1, n_tasks + 1)), total
                 assert kills >= 3, f"chaos too tame: {kills} kills"
-                # quiescence: nothing processing or queued once done
+                # quiescence: nothing processing or queued once done.
+                # The client's answer can land while a lost-replica
+                # recompute of some _inc straggler is still in flight
+                # (a kill raced the finish) — give the scheduler a
+                # settle window before asserting
                 s = cluster.scheduler
-                for ts in s.state.tasks.values():
-                    assert ts.state in ("memory", "released", "forgotten"), ts
+                def _busy():
+                    return [
+                        ts for ts in s.state.tasks.values()
+                        if ts.state not in ("memory", "released", "forgotten")
+                    ]
+                deadline = asyncio.get_running_loop().time() + 15
+                while _busy() and asyncio.get_running_loop().time() < deadline:
+                    await asyncio.sleep(0.1)
+                assert not _busy(), _busy()[:5]
 
 
-@gen_test(timeout=280)
+@gen_test(timeout=480)
 async def test_chaos_kill_workers_under_load():
     """5k-task workload while a chaos clock (exponential, mean ~0.8 s)
     closes a random worker and replaces it.  Done means: every result
@@ -93,7 +109,7 @@ async def test_chaos_kill_workers_under_load():
     await _chaos_soak(5000, "inproc")
 
 
-@gen_test(timeout=280)
+@gen_test(timeout=480)
 async def test_chaos_kill_workers_under_load_tcp():
     """The same soak with every comm over REAL sockets: worker death now
     severs TCP streams mid-frame, so the recovery paths digest framing

@@ -570,10 +570,10 @@ async def test_gather_from_workers_retries_busy_holder():
                     "data": {k: f"v-{k}" for k in keys},
                     "nbytes": {k: 8 for k in keys}}
 
-    data, missing, failed = await gather_from_workers(
+    data, missing, busy, failed = await gather_from_workers(
         {"k1": ["tcp://w:1"]}, rpc=FakeRPC
     )
-    assert data == {"k1": "v-k1"} and not missing and not failed
+    assert data == {"k1": "v-k1"} and not missing and not busy and not failed
     assert calls["n"] == 2
 
 
@@ -592,3 +592,196 @@ async def test_client_heartbeat_stamps_last_seen():
                 if cs.last_seen > seen0:
                     break
             assert cs.last_seen > seen0
+
+
+@gen_test(timeout=60)
+async def test_gather_from_workers_reports_busy_keys_distinctly():
+    """A holder that answers busy past the round budget is saturated,
+    not dead: its keys come back in the `busy` category, NOT `missing`
+    (ADVICE.md #1) — data that exists must never surface as a data-loss
+    error.  (Unbounded in-place retry is no better: a closing worker
+    that keeps answering busy would wedge the gather coroutine.)"""
+    from distributed_tpu.utils import comm as comm_utils
+    from distributed_tpu.utils.comm import gather_from_workers
+
+    calls = {"n": 0}
+
+    class FakeRPC:
+        def __init__(self, addr):
+            pass
+
+        async def get_data(self, keys=(), who=None):
+            calls["n"] += 1
+            return {"status": "busy"}
+
+    saved = comm_utils.BUSY_BACKOFF_BASE, comm_utils.BUSY_BACKOFF_MAX
+    comm_utils.BUSY_BACKOFF_BASE, comm_utils.BUSY_BACKOFF_MAX = 1e-4, 1e-3
+    try:
+        data, missing, busy, failed = await gather_from_workers(
+            {"k1": ["tcp://w:1"]}, rpc=FakeRPC
+        )
+    finally:
+        comm_utils.BUSY_BACKOFF_BASE, comm_utils.BUSY_BACKOFF_MAX = saved
+    assert not data and not missing and not failed
+    assert busy == {"k1"}
+    assert calls["n"] > comm_utils.BUSY_ROUNDS_MAX
+
+
+@gen_test(timeout=30)
+async def test_scheduler_gather_retries_busy_keys_with_refreshed_who_has():
+    """Scheduler.gather re-resolves who_has and retries keys the bulk
+    fetch reported busy, instead of folding them into 'missing'
+    (ADVICE.md #1): a transiently saturated holder costs a retry, not a
+    client-visible error."""
+    from distributed_tpu.scheduler import server as sched_mod
+
+    calls = []
+
+    async def fake_gather(who_has, rpc):
+        calls.append(dict(who_has))
+        if len(calls) == 1:
+            return {}, set(), {"k1"}, []
+        return {"k1": 41}, set(), set(), []
+
+    orig = sched_mod.gather_from_workers
+    sched_mod.gather_from_workers = fake_gather
+    try:
+        async with Scheduler(listen_addr="inproc://", validate=True) as s:
+            resp = await s.gather(keys=["k1"])
+    finally:
+        sched_mod.gather_from_workers = orig
+    assert resp["status"] == "OK"
+    assert len(calls) == 2  # one refresh+retry round for the busy key
+
+
+@gen_test(timeout=30)
+async def test_heartbeat_status_reconciles_by_seq_not_wall_clock():
+    """A heartbeat's status view is ordered against stream-delivered
+    flips by the worker-stamped status_seq: a delayed heartbeat that
+    predates a pause can NEVER spuriously unpause, no matter how late it
+    arrives (ADVICE.md #2 replaced the 1.0s wall-clock window)."""
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        ws = s.state.add_worker_state("tcp://w:1", nthreads=1)
+        s._last_worker_seen["tcp://w:1"] = 0.0
+
+        # stream delivers a pause stamped seq 2
+        s.handle_worker_status_change(
+            status="paused", worker="tcp://w:1", stimulus_id="s1",
+            status_seq=2,
+        )
+        assert ws.status == "paused" and ws.status_seq == 2
+
+        # a heartbeat snapshotted BEFORE the pause arrives arbitrarily
+        # late (simulate "way outside any wall-clock window")
+        ws.status_changed_at -= 30.0
+        await s.heartbeat_worker(
+            address="tcp://w:1", executing_status="running", status_seq=1,
+        )
+        assert ws.status == "paused", "stale heartbeat view must never win"
+
+        # a stale STREAM flip ordered behind the applied seq is dropped too
+        s.handle_worker_status_change(
+            status="running", worker="tcp://w:1", stimulus_id="s2",
+            status_seq=1,
+        )
+        assert ws.status == "paused"
+
+        # a provably-newer heartbeat view applies (the lost-stream-
+        # message-at-startup case the reconciliation exists for)
+        await s.heartbeat_worker(
+            address="tcp://w:1", executing_status="running", status_seq=3,
+        )
+        assert ws.status == "running" and ws.status_seq == 3
+
+
+@gen_test(timeout=60)
+async def test_cancelled_batch_emits_failure_events():
+    """Cancelling _execute_batch outside shutdown must produce a
+    completion event per batched task instead of wedging them all in
+    'executing' (ADVICE.md #3: mirror _execute's BaseException
+    handling)."""
+    import threading
+
+    from distributed_tpu.worker.state_machine import (
+        ExecuteFailureEvent,
+        WTaskState,
+    )
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        release.wait(10)
+        return 1
+
+    class Spec:
+        fn = staticmethod(blocker)
+
+        def substitute(self, data):
+            return blocker, (), {}
+
+    async with Scheduler(listen_addr="inproc://", validate=True) as s:
+        async with Worker(s.address, nthreads=1, name="a") as w:
+            ts = WTaskState("batch-k1", run_spec=Spec())
+            ts.state = "executing"
+            w.state.tasks["batch-k1"] = ts
+            events = []
+            w.handle_stimulus = lambda *e: events.extend(e)
+            try:
+                task = asyncio.create_task(
+                    w._execute_batch([("batch-k1", "sid-1")])
+                )
+                while not started.is_set():
+                    await asyncio.sleep(0.01)
+                task.cancel()
+                # conversion, not propagation: the batch coroutine turns
+                # the cancellation into per-task failure events
+                await task
+                assert [
+                    (e.key, type(e)) for e in events
+                ] == [("batch-k1", ExecuteFailureEvent)]
+                assert "cancel" in events[0].exception_text.lower()
+            finally:
+                release.set()
+                del w.handle_stimulus
+                del w.state.tasks["batch-k1"]
+
+
+@gen_test(timeout=30)
+async def test_eventstream_refs_released_on_client_disconnect():
+    """A consumer that starts the eventstream and disconnects without
+    stopping it must not pin the per-completion EventStreamPlugin
+    forever (ADVICE.md #4): its refs die with its comm."""
+    async with await new_cluster(n_workers=1) as cluster:
+        s = cluster.scheduler
+        async with Client(cluster.scheduler_address) as c:
+            topic = await c.eventstream_start()
+            assert topic == "task-events"
+            assert "eventstream" in s.state.plugins
+            assert s._eventstream_refs == 1
+        # client gone WITHOUT eventstream_stop
+        for _ in range(300):
+            if "eventstream" not in s.state.plugins:
+                break
+            await asyncio.sleep(0.01)
+        assert "eventstream" not in s.state.plugins
+        assert s._eventstream_refs == 0
+
+        # a second, well-behaved consumer is unaffected by refcounts of
+        # dead ones: start/stop still works
+        async with Client(cluster.scheduler_address) as c2:
+            await c2.eventstream_start()
+            assert "eventstream" in s.state.plugins
+            await c2.eventstream_stop()
+            assert "eventstream" not in s.state.plugins
+
+        # an unmatched stop must not steal a reference another live
+        # consumer holds
+        async with Client(cluster.scheduler_address) as c3:
+            async with Client(cluster.scheduler_address) as c4:
+                await c3.eventstream_start()
+                await c4.eventstream_stop()  # c4 never started one
+                assert "eventstream" in s.state.plugins
+                await c3.eventstream_stop()
+                assert "eventstream" not in s.state.plugins
