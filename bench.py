@@ -37,8 +37,12 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT = 90.0
-PROBE_RETRIES = 3
+# Backend probe budget (BENCH_r05 burned 90 s of bench wall on a wedged
+# tunnel): configurable, and a TIMEOUT is terminal — a tunnel that cannot
+# answer a trivial device query within the budget will not recover within
+# a retry backoff, so only probe ERRORS (transient init failures) retry.
+PROBE_TIMEOUT = float(os.environ.get("DTPU_BENCH_PROBE_TIMEOUT", "30"))
+PROBE_RETRIES = int(os.environ.get("DTPU_BENCH_PROBE_RETRIES", "3"))
 PROBE_BACKOFF = [5.0, 15.0]
 
 # (name, timeout_s, force_cpu)
@@ -376,29 +380,37 @@ async def _run_steal(steal_enabled):
 
 
 async def cfg_steal():
-    # best-of-3: this box is a shared single-core host and the measured
-    # wall of an 0.1 s-ideal run swings 0.18-0.28 s with load; external
-    # noise only ever ADDS time, so the minimum is the faithful estimate
-    # of the steal kernel's balance quality (all runs reported)
+    # median-of-N (N >= 3, odd): this box is a shared host and the wall
+    # of an 0.1 s-ideal run swings 0.18-0.30 s with load (BENCH_r05 saw
+    # one of three runs at 0.302 s vs 0.196 s).  The MEDIAN is robust to
+    # a single loaded run while not hiding a real regression the way
+    # min-of-N does; all runs plus their spread are reported so a
+    # regression is distinguishable from noise.
+    import statistics
+
+    n_runs = max(int(os.environ.get("DTPU_BENCH_STEAL_RUNS", "3")), 3)
+    n_runs += 1 - n_runs % 2  # odd, so the median is a real run
     walls = []
     ideal = n_tasks = None
-    for _ in range(3):
+    for _ in range(n_runs):
         wall, ideal, n_tasks = await _run_steal(True)
         walls.append(round(wall, 3))
-    wall = min(walls)
-    # same best-of-N denoising for the baseline: a single noisy no-steal
-    # run against a min-of-3 steal run would overstate the benefit
+    wall = statistics.median(walls)
+    # median-of-3 for the baseline too: a single noisy no-steal run
+    # against a median steal run would misstate the benefit either way
     walls_off = []
-    for _ in range(2):
+    for _ in range(3):
         wall_off, _, _ = await _run_steal(False)
         walls_off.append(round(wall_off, 3))
-    wall_off = min(walls_off)
+    wall_off = statistics.median(walls_off)
     return {
         "desc": "imbalanced slowinc x320 from one worker's data, 64 workers",
         "n_tasks": n_tasks,
         "wall_s": wall,
         "wall_s_runs": walls,
+        "wall_s_spread": round(max(walls) - min(walls), 3),
         "wall_s_no_steal": round(wall_off, 3),
+        "wall_s_no_steal_runs": walls_off,
         "ideal_s": round(ideal, 3),
         "balance_efficiency": round(ideal / wall, 3),
         "vs_baseline": round(wall_off / wall, 1),
@@ -727,6 +739,110 @@ def cfg_dag_1m():
 
 
 # =====================================================================
+# smoke mode: seconds-scale, CPU-pinned miniatures of the live-path and
+# placement-path configs, run by a tier-1 test on every PR so the perf
+# plumbing (batched transition engine, coalesced streams, chunked
+# pack/upload) is exercised continuously instead of only in full bench
+# rounds.  Unlike the headline harness this RAISES on failure — it is a
+# CI gate, not a measurement round.
+# =====================================================================
+
+SMOKE_TASKS = 120
+SMOKE_DAG_TASKS = 6_000
+
+
+async def _smoke_cluster() -> dict:
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+    from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+
+    async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            # trivial-task flood: exercises task-finished batch dispatch
+            # and the payload-boundary send coalescer
+            t0 = time.perf_counter()
+            await c.gather(c.map(_inc, range(SMOKE_TASKS)))
+            flood_wall = time.perf_counter() - t0
+            # small dependent graph: compute-task batches + free/release
+            g = Graph()
+            for i in range(24):
+                g.tasks[f"src-{i}"] = TaskSpec(_inc, (i,))
+                g.tasks[f"dep-{i}"] = TaskSpec(_inc, (TaskRef(f"src-{i}"),))
+            level = [f"dep-{i}" for i in range(24)]
+            g.tasks["root"] = TaskSpec(
+                _sum_list, ([TaskRef(k) for k in level],)
+            )
+            t0 = time.perf_counter()
+            futs = c.compute_graph(g, ["root"])
+            result = await futs["root"].result()
+            graph_wall = time.perf_counter() - t0
+            assert result == sum(range(24)) + 48, result
+    return {
+        "n_tasks": SMOKE_TASKS + len(g.tasks),
+        "flood_wall_s": round(flood_wall, 3),
+        "graph_wall_s": round(graph_wall, 3),
+        "overhead_us_per_task": round(flood_wall / SMOKE_TASKS * 1e6),
+    }
+
+
+def _smoke_placement() -> dict:
+    import numpy as np
+
+    from distributed_tpu.ops.leveled import (
+        place_graph_streamed,
+        validate_leveled,
+    )
+
+    rng = np.random.default_rng(0)
+    T, W = SMOKE_DAG_TASKS, 32
+    durations = rng.uniform(0.01, 1.0, T).astype(np.float32)
+    out_bytes = rng.uniform(1e3, 1e7, T).astype(np.float32)
+    n_deps = rng.integers(0, 3, T)
+    n_deps[0] = 0
+    dst = np.repeat(np.arange(T), n_deps).astype(np.int32)
+    src = (rng.random(len(dst)) * np.maximum(dst, 1)).astype(np.int32)
+    nthreads = np.full(W, 2, np.int32)
+    occ0 = np.zeros(W, np.float32)
+    running = np.ones(W, bool)
+    t0 = time.perf_counter()
+    packed, res = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BANDWIDTH, chunk_rows=2048, min_stream=1,
+    )
+    wall = time.perf_counter() - t0
+    validate_leveled(packed, res, src, dst, running)
+    return {
+        "n_tasks": T,
+        "wall_s": round(wall, 3),
+        "n_waves": int(res.n_waves),
+    }
+
+
+def run_smoke():
+    """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
+    line on stdout; raises (non-zero exit) on any failure."""
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    t0 = time.perf_counter()
+    configs = {
+        "cluster": asyncio.run(_smoke_cluster()),
+        "placement": _smoke_placement(),
+    }
+    print(
+        json.dumps(
+            {
+                "smoke": True,
+                "total_s": round(time.perf_counter() - t0, 1),
+                "configs": configs,
+            }
+        )
+    )
+
+
+# =====================================================================
 # harness
 # =====================================================================
 
@@ -765,7 +881,13 @@ def _parse_json_tail(stdout: str):
 
 
 def probe_backend(env):
-    """Probe jax backend init in a subprocess: hard timeout + retries."""
+    """Probe jax backend init in a subprocess: hard timeout + retries.
+
+    A probe TIMEOUT fails fast (no retries): the accelerator tunnel is
+    wedged, not warming up — BENCH_r05 spent 90 s x no useful retries on
+    exactly this.  Probe errors (transient init failures) still retry
+    with backoff.  ``DTPU_BENCH_PROBE_TIMEOUT`` / ``_RETRIES`` tune it.
+    """
     last_err = None
     for attempt in range(PROBE_RETRIES):
         try:
@@ -785,7 +907,12 @@ def probe_backend(env):
                     return line.split("=", 1)[1], None
             last_err = (out.stderr or out.stdout).strip()[-400:]
         except subprocess.TimeoutExpired:
-            last_err = f"backend probe timed out after {PROBE_TIMEOUT}s"
+            last_err = (
+                f"backend probe timed out after {PROBE_TIMEOUT}s "
+                f"(device backend unreachable; falling back to cpu — "
+                f"set DTPU_BENCH_PROBE_TIMEOUT to adjust)"
+            )
+            break  # a wedged tunnel will not answer the next attempt either
         if attempt < PROBE_RETRIES - 1:
             time.sleep(PROBE_BACKOFF[min(attempt, len(PROBE_BACKOFF) - 1)])
     return None, last_err
@@ -922,7 +1049,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+    if "--smoke" in sys.argv[1:]:
+        run_smoke()
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--config":
         run_config(sys.argv[2], force_cpu="--force-cpu" in sys.argv)
     else:
         try:

@@ -38,7 +38,7 @@ def _needs_build() -> bool:
 
 def _build() -> bool:
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         *_SOURCES, "-o", _LIB_PATH,
     ]
     try:

@@ -14,6 +14,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -32,55 +33,82 @@ extern "C" {
 //                merges — have two comparable inputs)
 //   dep_total[t] sum of out_bytes over t's dependencies
 //   offsets[l]   start of level l in perm; offsets[n_levels] == T
-int64_t graphpack(
+static int64_t topo_core(
     int64_t T, int64_t E,
     const float* out_bytes,
     const int32_t* src, const int32_t* dst,
     int32_t* level, int32_t* perm, int32_t* heavy, int32_t* heavy2,
-    float* dep_total, int32_t* offsets)
+    float* dep_total, int32_t* offsets,
+    int32_t* indeg_out, int32_t* inv)
 {
     if (T <= 0) return 0;
 
     std::vector<int32_t> indeg(T, 0);
-    std::vector<float> heavy_bytes(T, -1.0f);
-    std::vector<float> heavy2_bytes(T, -1.0f);
-    for (int64_t t = 0; t < T; ++t) {
-        heavy[t] = -1;
-        heavy2[t] = -1;
-        dep_total[t] = 0.0f;
-        level[t] = -1;
+    // int32 CSR vectors: E < 2^31 by construction (int32 edge indices),
+    // and halving the pointer arrays' traffic matters — the peel is
+    // memory-bound
+    std::vector<int32_t> outptr(T + 1, 0);
+
+    // The edge-derived reductions split into two data-independent
+    // halves: the HEAVY half (top-2 heaviest deps + dep byte totals,
+    // feeding the transfer cost model) and the TOPOLOGY half (indegree
+    // + CSR out-degree counts, feeding the Kahn peel).  On multi-core
+    // hosts the heavy half runs on a sibling thread while this thread
+    // continues straight into CSR fill and the peel — the heavy outputs
+    // are only needed by the (later) row fill, so the join happens at
+    // return.  Both halves scan edges in identical order, so ties and
+    // results are bit-identical to the sequential pass.  The pre-fusion
+    // layout additionally walked the E-sized arrays four times instead
+    // of these two (PERF.md Round 6).
+    auto heavy_pass = [&]() {
+        std::vector<float> heavy_bytes(T, -1.0f);
+        std::vector<float> heavy2_bytes(T, -1.0f);
+        for (int64_t t = 0; t < T; ++t) {
+            heavy[t] = -1;
+            heavy2[t] = -1;
+            dep_total[t] = 0.0f;
+        }
+        for (int64_t e = 0; e < E; ++e) {
+            int32_t s = src[e], d = dst[e];
+            if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
+            float b = out_bytes[s];
+            dep_total[d] += b;
+            if (b > heavy_bytes[d] || (b == heavy_bytes[d] && s < heavy[d])) {
+                heavy2_bytes[d] = heavy_bytes[d];
+                heavy2[d] = heavy[d];
+                heavy_bytes[d] = b;
+                heavy[d] = s;
+            } else if (b > heavy2_bytes[d]
+                       || (b == heavy2_bytes[d] && s < heavy2[d])) {
+                heavy2_bytes[d] = b;
+                heavy2[d] = s;
+            }
+        }
+    };
+    std::thread heavy_thread;
+    bool threaded =
+        E >= (int64_t)1 << 18 && std::thread::hardware_concurrency() > 1;
+    if (threaded) {
+        heavy_thread = std::thread(heavy_pass);
+    } else {
+        heavy_pass();
     }
 
-    // one edge pass: indegree, top-2 heavy deps, dep byte totals
+    for (int64_t t = 0; t < T; ++t) level[t] = -1;
     for (int64_t e = 0; e < E; ++e) {
         int32_t s = src[e], d = dst[e];
         if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
         indeg[d] += 1;
-        float b = out_bytes[s];
-        dep_total[d] += b;
-        if (b > heavy_bytes[d] || (b == heavy_bytes[d] && s < heavy[d])) {
-            heavy2_bytes[d] = heavy_bytes[d];
-            heavy2[d] = heavy[d];
-            heavy_bytes[d] = b;
-            heavy[d] = s;
-        } else if (b > heavy2_bytes[d]
-                   || (b == heavy2_bytes[d] && s < heavy2[d])) {
-            heavy2_bytes[d] = b;
-            heavy2[d] = s;
-        }
-    }
-
-    // CSR out-adjacency (counting sort of edges by src)
-    std::vector<int64_t> outptr(T + 1, 0);
-    for (int64_t e = 0; e < E; ++e) {
-        int32_t s = src[e], d = dst[e];
-        if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
         outptr[s + 1] += 1;
     }
+    if (indeg_out != nullptr)
+        std::memcpy(indeg_out, indeg.data(), T * sizeof(int32_t));
+
+    // CSR out-adjacency fill (second and last edge pass)
     for (int64_t t = 0; t < T; ++t) outptr[t + 1] += outptr[t];
     std::vector<int32_t> outadj(outptr[T]);
     {
-        std::vector<int64_t> fill(outptr.begin(), outptr.end() - 1);
+        std::vector<int32_t> fill(outptr.begin(), outptr.end() - 1);
         for (int64_t e = 0; e < E; ++e) {
             int32_t s = src[e], d = dst[e];
             if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
@@ -105,12 +133,15 @@ int64_t graphpack(
         placed += (int64_t)frontier.size();
         next.clear();
         for (int32_t t : frontier)
-            for (int64_t j = outptr[t]; j < outptr[t + 1]; ++j)
+            for (int32_t j = outptr[t]; j < outptr[t + 1]; ++j)
                 if (--indeg[outadj[j]] == 0) next.push_back(outadj[j]);
         frontier.swap(next);
         ++n_levels;
     }
-    if (placed != T) return -1;  // cycle
+    if (placed != T) {  // cycle
+        if (heavy_thread.joinable()) heavy_thread.join();
+        return -1;
+    }
 
     // counting sort by level; scanning tasks in ascending original
     // index keeps the within-level order stable by construction
@@ -119,7 +150,21 @@ int64_t graphpack(
     for (int64_t l = 0; l < n_levels; ++l) fill[l + 1] += fill[l];
     for (int64_t l = 0; l <= n_levels; ++l) offsets[l] = (int32_t)fill[l];
     for (int64_t t = 0; t < T; ++t) perm[fill[level[t]]++] = (int32_t)t;
+    if (inv != nullptr)
+        for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
+    if (heavy_thread.joinable()) heavy_thread.join();
     return n_levels;
+}
+
+int64_t graphpack(
+    int64_t T, int64_t E,
+    const float* out_bytes,
+    const int32_t* src, const int32_t* dst,
+    int32_t* level, int32_t* perm, int32_t* heavy, int32_t* heavy2,
+    float* dep_total, int32_t* offsets)
+{
+    return topo_core(T, E, out_bytes, src, dst, level, perm, heavy, heavy2,
+                     dep_total, offsets, nullptr, nullptr);
 }
 
 // Full pack: graphpack plus the level-sorted, remapped per-task arrays
@@ -150,19 +195,8 @@ int64_t graphpack_topo(
     int32_t* heavy, int32_t* heavy2, float* dep_total,
     int32_t* indeg, int32_t* inv)
 {
-    if (T <= 0) return 0;
-    for (int64_t t = 0; t < T; ++t) indeg[t] = 0;
-    for (int64_t e = 0; e < E; ++e) {
-        int32_t s = src[e], d = dst[e];
-        if (s < 0 || s >= T || d < 0 || d >= T || s == d) continue;
-        indeg[d] += 1;
-    }
-    int64_t n_levels = graphpack(T, E, out_bytes, src, dst,
-                                 level, perm, heavy, heavy2,
-                                 dep_total, offsets);
-    if (n_levels < 0) return -1;
-    for (int64_t i = 0; i < T; ++i) inv[perm[i]] = (int32_t)i;
-    return n_levels;
+    return topo_core(T, E, out_bytes, src, dst, level, perm, heavy, heavy2,
+                     dep_total, offsets, indeg, inv);
 }
 
 // Streamed-pack phase 2: fill sorted rows [i0, i1) of the per-task

@@ -178,7 +178,7 @@ def pack_graph(
     if lib is not None and T:
         level = np.empty(T, np.int32)
         perm = np.empty(T, np.int32)
-        offsets_buf = np.zeros(T + 1, np.int32)
+        offsets_buf = np.empty(T + 1, np.int32)  # pass writes [0, n_levels]
         dur_s = np.empty(T, np.float32)
         heavy_s = np.empty(T, np.int32)
         heavy2_s = np.empty(T, np.int32)
@@ -738,7 +738,7 @@ def place_graph_streamed(
     running,
     bandwidth: float = 100e6,
     latency: float = 0.001,
-    compact: bool = True,
+    compact: bool | str = "auto",
     chunk_rows: int = 131072,
     min_stream: int = 262144,
     timings: dict | None = None,
@@ -761,7 +761,9 @@ def place_graph_streamed(
       later chunks are still crossing the wire, and the segmented D2H
       of ``_RunState`` overlaps the tail as before.
 
-    With ``compact`` (default) chunks use the 11 B/task wire format
+    With ``compact`` ("auto" default: enabled exactly when the backend
+    is not cpu — a memcpy "wire" gains nothing from the u8 log encode's
+    host cost) chunks use the 11 B/task wire format
     (see ``_enc_heavy_pair``/``_enc_cost``) instead of 16 B/task —
     placement validity is unaffected (same kernel, same wave order); the
     cost model carries ±4.5% quantization on transfer seconds and
@@ -805,7 +807,7 @@ def place_graph_streamed(
     f32p = ctypes.POINTER(ctypes.c_float)
     level = np.empty(T, np.int32)
     perm = np.empty(T, np.int32)
-    offsets_buf = np.zeros(T + 1, np.int32)
+    offsets_buf = np.empty(T + 1, np.int32)  # pass writes [0, n_levels]
     heavy = np.empty(T, np.int32)
     heavy2 = np.empty(T, np.int32)
     dep_total = np.empty(T, np.float32)
@@ -829,13 +831,18 @@ def place_graph_streamed(
     Tp = T + _compute_pad(T, runs, offsets)
     Lp = _bucket(n_levels + 1, floor=64)
     # host fill targets are Tp-sized with a zero tail so chunk windows
-    # (fixed length C, clamped into [0, Tp)) always slice cleanly
-    dur_s = np.zeros(Tp, np.float32)
-    heavy_s = np.zeros(Tp, np.int32)
-    heavy2_s = np.zeros(Tp, np.int32)
-    xp_s = np.zeros(Tp, np.float32)
-    xp2_s = np.zeros(Tp, np.float32)
-    xa_s = np.zeros(Tp, np.float32)
+    # (fixed length C, clamped into [0, Tp)) always slice cleanly; only
+    # the tail needs zeroing — the fill chunks cover every row in [0, T)
+    # and np.zeros over six 1M-row arrays costs real milliseconds of the
+    # serial phase on a one-core host
+    dur_s = np.empty(Tp, np.float32)
+    heavy_s = np.empty(Tp, np.int32)
+    heavy2_s = np.empty(Tp, np.int32)
+    xp_s = np.empty(Tp, np.float32)
+    xp2_s = np.empty(Tp, np.float32)
+    xa_s = np.empty(Tp, np.float32)
+    for _buf in (dur_s, heavy_s, heavy2_s, xp_s, xp2_s, xa_s):
+        _buf[T:] = 0
     packed = PackedGraph(
         perm=perm, level=level, offsets=offsets, n_levels=int(n_levels),
         duration_s=dur_s[:T], heavy_s=heavy_s[:T], heavy2_s=heavy2_s[:T],
@@ -847,6 +854,11 @@ def place_graph_streamed(
     wide, uniform, thr_h, run_h, occ_h = _worker_params(
         nthreads, occupancy0, running
     )
+    if compact == "auto":
+        # the 11 B/task format exists to shrink the H2D wire of tunneled
+        # accelerators; on the cpu backend "upload" is a memcpy and the
+        # u8 log encode is pure extra host work in the serial pipeline
+        compact = jax.default_backend() != "cpu"
     fmt = "packed" if (compact and Tp < _PACK_LIMIT) else "f16"
     if timings is not None:
         timings["fmt"] = fmt

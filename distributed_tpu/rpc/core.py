@@ -215,6 +215,12 @@ class Server:
             config.get(self.blocked_handlers_config_key) or []
         )
         self.stream_handlers: dict[str, Callable] = dict(stream_handlers or {})
+        # same-op runs within one batched-stream payload can be folded
+        # into a single call: ``stream_batch_handlers[op](msgs, **extra)``
+        # receives the whole run as a list of message dicts (op stripped).
+        # Servers opt in per op; anything unregistered keeps the
+        # per-message path below.
+        self.stream_batch_handlers: dict[str, Callable] = {}
         self.connection_args = connection_args or {}
         self.deserialize = deserialize
         self.name = name
@@ -427,8 +433,11 @@ class Server:
                 msgs = await comm.read()
                 if not isinstance(msgs, (tuple, list)):
                     msgs = (msgs,)
-                for msg in msgs:
+                i, n = 0, len(msgs)
+                while i < n:
+                    msg = msgs[i]
                     if msg == "OK":  # initial handshake ack
+                        i += 1
                         continue
                     op = msg.pop("op", None)
                     if op is None:
@@ -436,6 +445,33 @@ class Server:
                     if op == "close-stream":
                         closed = True
                         break
+                    batch_handler = self.stream_batch_handlers.get(op)
+                    if batch_handler is not None:
+                        # fold the whole consecutive same-op run (a
+                        # task-finished flood, a free/release flood) into
+                        # ONE dispatch: the handler sees the run as a
+                        # list and drives the state machine in a single
+                        # batched pass instead of once per message
+                        j = i + 1
+                        while (
+                            j < n
+                            and isinstance(msgs[j], dict)
+                            and msgs[j].get("op") == op
+                        ):
+                            msgs[j].pop("op", None)
+                            j += 1
+                        batch = list(msgs[i:j])
+                        i = j
+                        try:
+                            result = batch_handler(batch, **extra)
+                            if result is not None and inspect.isawaitable(result):
+                                await result
+                        except Exception:
+                            logger.exception(
+                                "stream batch handler %r failed", op
+                            )
+                        continue
+                    i += 1
                     handler = self.stream_handlers.get(op)
                     if handler is None:
                         logger.error("unknown stream op %r", op)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math as _math
 import threading
 from typing import TYPE_CHECKING, Any
 
@@ -204,7 +205,18 @@ class JaxPlacement:
         valid_workers: "set[WorkerState] | None",
     ) -> "tuple[str, WorkerState | None]":
         """(verdict, ws): ("hit", ws) place now; ("park", ws) defer to
-        ws's queue-pull; ("miss", None) hint unusable, use the oracle."""
+        ws's queue-pull; ("miss", None) hint unusable, use the oracle.
+
+        This is the single consumption point for BOTH transition
+        drivers: the per-key engine and the batched flood engine
+        (state.py ``stimulus_tasks_finished_batch``) route every ready
+        task of a drain round through here against the LIVE occupancy,
+        so hint verdicts are identical whichever driver delivered the
+        stimulus — the batching lives in message dispatch and send
+        coalescing, never in placement semantics (docs/batching.md).
+        The plan itself is the batch decision: one ``plan_graph`` device
+        call per submitted graph amortizes decide_worker over the whole
+        batch, and each resolve is a dict lookup plus backlog math."""
         entry = self.plan.get(ts.key)
         if entry is None:
             return "miss", None
@@ -302,8 +314,6 @@ class JaxPlacement:
         if self.home_depth is None:
             depth = float("inf")
         else:
-            import math as _math
-
             sat = state.WORKER_SATURATION
             depth = (
                 _math.ceil(ws.nthreads * sat) if _math.isfinite(sat)

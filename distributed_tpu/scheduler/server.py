@@ -223,12 +223,32 @@ class Scheduler(Server):
         self.stream_handlers["subscribe-topic"] = self.subscribe_topic
         self.stream_handlers["unsubscribe-topic"] = self.unsubscribe_topic
         self.stream_handlers["log-event-client"] = self.handle_client_log_event
+        # same-op floods within one stream payload fold into a single
+        # batched state-machine pass (rpc/core.py handle_stream;
+        # docs/batching.md) — the per-message handlers above remain the
+        # oracle path for lone messages and direct calls
+        self.stream_batch_handlers["task-finished"] = self.handle_tasks_finished
+        self.stream_batch_handlers["task-erred"] = self.handle_tasks_erred
+        self.stream_batch_handlers["release-worker-data"] = (
+            self.handle_release_data_batch
+        )
+        # send_all output is staged per stream payload and flushed once
+        # at the payload boundary (handle_stream calls
+        # stream_payload_flush) with per-destination coalescing; the
+        # call_soon backstop covers non-stream callers (RPC handlers,
+        # periodic callbacks) at zero added latency — BatchedSend only
+        # writes from its background task anyway
+        self._pending_client_msgs: dict[str, list] = {}
+        self._pending_worker_msgs: dict[str, list] = {}
+        self._pending_flush_scheduled = False
+        self._loop: asyncio.AbstractEventLoop | None = None  # set at start
 
     # ----------------------------------------------------------- lifecycle
 
     async def start_unsafe(self) -> "Scheduler":
         from distributed_tpu import native
 
+        self._loop = asyncio.get_running_loop()
         native.prebuild_async()
         addr = self._listen_addr or "tcp://127.0.0.1:0"
         listen_args = (
@@ -314,6 +334,7 @@ class Scheduler(Server):
                         await res
                 except Exception:
                     logger.exception("extension close failed")
+        self.stream_payload_flush()  # staged sends must not die buffered
         # tell workers to shut down
         for addr, bs in list(self.stream_comms.items()):
             try:
@@ -331,7 +352,42 @@ class Scheduler(Server):
 
     def send_all(self, client_msgs: dict, worker_msgs: dict) -> None:
         """Route state-machine output onto the batched streams
-        (reference scheduler.py:6067)."""
+        (reference scheduler.py:6067).
+
+        Messages are STAGED, not written: everything produced while one
+        stream payload is being processed (often a whole task-finished
+        flood) flushes in a single pass at the payload boundary, where
+        per-destination runs coalesce (compute-task batches, merged
+        free-keys).  Order per destination is strictly preserved."""
+        for client, msgs in client_msgs.items():
+            self._pending_client_msgs.setdefault(client, []).extend(msgs)
+        for worker, msgs in worker_msgs.items():
+            self._pending_worker_msgs.setdefault(worker, []).extend(msgs)
+        if self._pending_flush_scheduled:
+            return
+        if not (self._pending_client_msgs or self._pending_worker_msgs):
+            return
+        self._pending_flush_scheduled = True
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            # not started / no running loop (sync tests, teardown):
+            # write through now
+            self._pending_flush_scheduled = False
+            self.stream_payload_flush()
+        else:
+            loop.call_soon(self.stream_payload_flush)
+
+    def stream_payload_flush(self) -> None:
+        """Write staged messages to the batched streams — called by
+        ``handle_stream`` at every payload boundary, by the ``call_soon``
+        backstop one tick after a non-stream send, and synchronously
+        before anything that writes to the same streams out-of-band
+        (``report``, ``restart``, ``close``) so ordering never inverts."""
+        self._pending_flush_scheduled = False
+        if not (self._pending_client_msgs or self._pending_worker_msgs):
+            return
+        client_msgs, self._pending_client_msgs = self._pending_client_msgs, {}
+        worker_msgs, self._pending_worker_msgs = self._pending_worker_msgs, {}
         for client, msgs in client_msgs.items():
             bs = self.client_comms.get(client)
             if bs is None:
@@ -345,7 +401,12 @@ class Scheduler(Server):
             if bs is None:
                 continue
             try:
-                bs.send(*[self._wrap_payload(m) for m in msgs])
+                bs.send(
+                    *[
+                        self._wrap_payload(m)
+                        for m in _coalesce_worker_stream_msgs(msgs)
+                    ]
+                )
             except CommClosedError:
                 logger.info("lost connection to worker %s", worker)
                 self._ongoing_background_tasks.call_soon(
@@ -368,6 +429,9 @@ class Scheduler(Server):
 
     def report(self, msg: dict, *, client: str | None = None) -> None:
         """Send a message to one or all clients."""
+        # report() writes the stream directly: flush staged sends first
+        # so a direct message can never overtake state-machine output
+        self.stream_payload_flush()
         if client is not None:
             targets = [client] if client in self.client_comms else []
         else:
@@ -597,6 +661,9 @@ class Scheduler(Server):
             cs.last_seen = time()
 
     async def handle_close_client(self, client: str = "", **kwargs: Any) -> None:
+        # direct stream write: flush staged sends first or stream-closed
+        # (terminal for the client's listen loop) overtakes final reports
+        self.stream_payload_flush()
         bs = self.client_comms.get(client)
         if bs is not None and not bs.closed():
             try:
@@ -708,6 +775,61 @@ class Scheduler(Server):
             traceback=traceback,
             **kwargs,
         )
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_tasks_finished(self, msgs: list, worker: str = "",
+                              **kw: Any) -> None:
+        """Batched ``task-finished`` flood: one state-machine pass, one
+        staged send (rpc/core.py batch dispatch)."""
+        finishes = []
+        for m in msgs:
+            key = m.pop("key", "")
+            w = m.pop("worker", "") or worker
+            stimulus_id = m.pop("stimulus_id", "") or seq_name("task-finished")
+            finishes.append((key, w, stimulus_id, m))
+        client_msgs, worker_msgs = self.state.stimulus_tasks_finished_batch(
+            finishes
+        )
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_tasks_erred(self, msgs: list, worker: str = "",
+                           **kw: Any) -> None:
+        """Batched ``task-erred`` flood (a worker death mid-tile erring a
+        whole co-assigned batch)."""
+        errors = []
+        for m in msgs:
+            key = m.pop("key", "")
+            w = m.pop("worker", "") or worker
+            stimulus_id = m.pop("stimulus_id", "") or seq_name("task-erred")
+            errors.append((key, w, stimulus_id, m))
+        client_msgs, worker_msgs = self.state.stimulus_tasks_erred_batch(errors)
+        self.send_all(client_msgs, worker_msgs)
+
+    def handle_release_data_batch(self, msgs: list, worker: str = "",
+                                  **kw: Any) -> None:
+        """Batched ``release-worker-data`` flood (AMM drop rounds).  The
+        generator interleaves replica removal with each key's transition
+        round exactly like sequential per-message handling, while all
+        rounds drain into one shared message pair."""
+        state = self.state
+
+        def rounds():
+            for m in msgs:
+                key = m.get("key", "")
+                w = m.get("worker", "") or worker
+                ts = state.tasks.get(key)
+                ws = state.workers.get(w)
+                if ts is None or ws is None:
+                    continue
+                if ws in ts.who_has:
+                    state.remove_replica(ts, ws)
+                if not ts.who_has:
+                    yield (
+                        {key: "released"},
+                        m.get("stimulus_id") or seq_name("release-data"),
+                    )
+
+        client_msgs, worker_msgs = state.transitions_batch(rounds())
         self.send_all(client_msgs, worker_msgs)
 
     def handle_release_data(self, key: Key = "", worker: str = "",
@@ -1040,6 +1162,7 @@ class Scheduler(Server):
         The report carries the initiating client's id so that client can
         ignore its own echo (it cancels its futures synchronously)."""
         stimulus_id = seq_name("restart")
+        self.stream_payload_flush()  # direct stream writes below
         for cs in list(self.state.clients.values()):
             if cs.client_key in self.client_comms:
                 # snapshot THIS client's wanted keys: its echo cancels
@@ -1752,3 +1875,40 @@ class Scheduler(Server):
             f"<Scheduler {addr!r} workers={len(self.state.workers)} "
             f"tasks={len(self.state.tasks)}>"
         )
+
+
+def _coalesce_worker_stream_msgs(msgs: list[dict]) -> list[dict]:
+    """Fold consecutive same-op runs bound for one worker into batch
+    messages: N ``compute-task`` dicts become one ``compute-tasks``
+    envelope (each inner message keeps its own stimulus_id — causal
+    stories survive), and adjacent ``free-keys`` with the SAME
+    stimulus_id merge their key lists.  Only consecutive runs merge, so
+    cross-op ordering (a free-keys fencing a later compute-task of the
+    same key) is preserved exactly.  Never mutates input messages: the
+    state machine shares message dicts across destinations."""
+    if len(msgs) < 2:
+        return msgs
+    out: list[dict] = []
+    for m in msgs:
+        prev = out[-1] if out else None
+        op = m.get("op")
+        if op == "compute-task" and prev is not None:
+            if prev.get("op") == "compute-tasks":
+                prev["tasks"].append(m)
+                continue
+            if prev.get("op") == "compute-task":
+                out[-1] = {"op": "compute-tasks", "tasks": [prev, m]}
+                continue
+        elif (
+            op == "free-keys"
+            and prev is not None
+            and prev.get("op") == "free-keys"
+            and prev.get("stimulus_id") == m.get("stimulus_id")
+        ):
+            out[-1] = {
+                **prev,
+                "keys": list(prev["keys"]) + list(m["keys"]),
+            }
+            continue
+        out.append(m)
+    return out

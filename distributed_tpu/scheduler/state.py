@@ -630,13 +630,14 @@ class SchedulerState:
         )
         if self.validate:
             self.validate_task_state(ts)
-        for plugin in list(self.plugins.values()):
-            try:
-                plugin.transition(
-                    key, start, actual_finish, stimulus_id=stimulus_id, **kwargs
-                )
-            except Exception:
-                logger.exception("Plugin %r failed in transition", plugin)
+        if self.plugins:
+            for plugin in list(self.plugins.values()):
+                try:
+                    plugin.transition(
+                        key, start, actual_finish, stimulus_id=stimulus_id, **kwargs
+                    )
+                except Exception:
+                    logger.exception("Plugin %r failed in transition", plugin)
         return recommendations, client_msgs, worker_msgs
 
     def _transitions(
@@ -1963,6 +1964,158 @@ class SchedulerState:
         self._transitions(r, client_msgs, worker_msgs, stimulus_id)
         recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
         self._transitions(recs2, client_msgs, worker_msgs, stimulus_id)
+        return client_msgs, worker_msgs
+
+    # ------------------------------------------- batched stimulus engine
+    #
+    # A batched-stream payload frequently carries a same-op FLOOD: a
+    # worker reporting dozens of finished tasks, an AMM round releasing
+    # replicas everywhere, a client graph submission.  The per-stimulus
+    # entries above process one message per call — handler dispatch,
+    # fresh message dicts, a queue-slots pass and a send_all flush per
+    # message.  The ``*_batch`` entries fold a whole flood into one
+    # engine pass: every event still drains through the SAME per-key
+    # ``_transition`` handlers in the same order with its own
+    # stimulus_id (so task states, ``transition_log``/``story`` entries
+    # and message multisets are bit-identical to N sequential calls —
+    # the per-key path remains the oracle, and
+    # tests/test_batched_engine.py replays random traces through both),
+    # but recommendations drain into ONE shared (client_msgs,
+    # worker_msgs) pair, the ready frontier of each drain is placed
+    # against the live occupancy without per-message re-entry, and the
+    # queue-slots pass runs only when the queue is non-empty (when it is
+    # empty the per-key pass is a no-op, so skipping it is exact).  The
+    # caller flushes the merged messages once per payload; the server
+    # additionally coalesces per-destination runs (compute-task batches,
+    # merged free-keys) on the wire.
+
+    def transitions_batch(
+        self,
+        batches: Iterable[tuple[dict[Key, str], str]],
+    ) -> tuple[dict, dict]:
+        """Drain several recommendation rounds into one shared message
+        pair.  Each ``(recommendations, stimulus_id)`` round is processed
+        to its fixed point before the next starts — identical semantics
+        to calling :meth:`transitions` per round, without the per-round
+        dict churn and per-round send."""
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        for recommendations, stimulus_id in batches:
+            # fault isolation matches the per-message path (one logged
+            # failure per message, the rest of the payload proceeds):
+            # a poison round must not discard the messages of rounds
+            # already applied to state
+            try:
+                self._transitions(
+                    dict(recommendations), client_msgs, worker_msgs, stimulus_id
+                )
+            except Exception:
+                logger.exception(
+                    "batched transition round failed (stimulus %s)",
+                    stimulus_id,
+                )
+        return client_msgs, worker_msgs
+
+    def stimulus_tasks_finished_batch(
+        self,
+        finishes: Iterable[tuple[Key, str, str, dict]],
+    ) -> tuple[dict, dict]:
+        """Batched :meth:`stimulus_task_finished`: one engine pass over a
+        flood of ``(key, worker, stimulus_id, kwargs)`` completions.
+
+        Events are processed in arrival order; each event's ready
+        frontier drains to a fixed point (placing newly-ready dependents
+        against the occupancy the sequential engine would see) before
+        the next event is applied, so the result is bit-identical to N
+        per-key calls — including per-key ``story`` entries, which keep
+        their own per-event stimulus_id for causal tracing.
+        """
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        for key, worker, stimulus_id, kwargs in finishes:
+            # per-event fault isolation, same as the per-message path
+            # (handle_stream logs one failure and proceeds): a poison
+            # event must not discard the flood's already-accumulated
+            # messages — transitions behind them are already applied
+            try:
+                ts = self.tasks.get(key)
+                if ts is None or ts.state in ("released", "forgotten", "erred"):
+                    # stale completion for a cancelled task: tell worker
+                    # to drop it (merged per destination at flush time)
+                    worker_msgs.setdefault(worker, []).append(
+                        {
+                            "op": "free-keys",
+                            "keys": [key],
+                            "stimulus_id": stimulus_id,
+                        }
+                    )
+                    continue
+                if ts.state == "memory":
+                    ws = self.workers.get(worker)
+                    if ws is not None and ws not in ts.who_has:
+                        self.add_replica(ts, ws)
+                    continue
+                if ts.state != "processing":
+                    continue
+                ts.metadata = kwargs.pop("metadata", None) or ts.metadata
+                recs, cmsgs, wmsgs = self._transition(
+                    key, "memory", stimulus_id, worker=worker, **kwargs
+                )
+                _merge_msgs_inplace(client_msgs, cmsgs)
+                _merge_msgs_inplace(worker_msgs, wmsgs)
+                self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+                if self.queued:
+                    # the per-key engine runs this pass per event; it is
+                    # a no-op on an empty queue, so gating on ``queued``
+                    # folds the common case without changing any outcome
+                    recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+                    self._transitions(
+                        recs2, client_msgs, worker_msgs, stimulus_id
+                    )
+            except Exception:
+                logger.exception(
+                    "batched task-finished event failed (%s from %s, "
+                    "stimulus %s)", key, worker, stimulus_id,
+                )
+        return client_msgs, worker_msgs
+
+    def stimulus_tasks_erred_batch(
+        self,
+        errors: Iterable[tuple[Key, str, str, dict]],
+    ) -> tuple[dict, dict]:
+        """Batched :meth:`stimulus_task_erred` over ``(key, worker,
+        stimulus_id, kwargs)`` failure reports; same bit-parity contract
+        as :meth:`stimulus_tasks_finished_batch`."""
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        for key, worker, stimulus_id, kwargs in errors:
+            try:
+                ts = self.tasks.get(key)
+                if ts is None or ts.state != "processing":
+                    continue
+                if ts.processing_on is None or ts.processing_on.address != worker:
+                    continue
+                recs, cmsgs, wmsgs = self._transition(
+                    key,
+                    "erred",
+                    stimulus_id,
+                    cause=key,
+                    worker=worker,
+                    **kwargs,
+                )
+                _merge_msgs_inplace(client_msgs, cmsgs)
+                _merge_msgs_inplace(worker_msgs, wmsgs)
+                self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+                if self.queued:
+                    recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+                    self._transitions(
+                        recs2, client_msgs, worker_msgs, stimulus_id
+                    )
+            except Exception:
+                logger.exception(
+                    "batched task-erred event failed (%s from %s, "
+                    "stimulus %s)", key, worker, stimulus_id,
+                )
         return client_msgs, worker_msgs
 
     def stimulus_retry(self, keys: Iterable[Key], stimulus_id: str) -> tuple[dict, dict]:

@@ -217,6 +217,7 @@ class Worker(Server):
         }
         stream_handlers = {
             "compute-task": self._stream_compute_task,
+            "compute-tasks": self._stream_compute_tasks,
             "free-keys": self._stream_free_keys,
             "remove-replicas": self._stream_remove_replicas,
             "acquire-replicas": self._stream_acquire_replicas,
@@ -760,6 +761,15 @@ class Worker(Server):
             if k in fields and (v is not None or k in ("run_spec", "span_id"))
         }
         self._enqueue_stream_event(ComputeTaskEvent(**msg))
+
+    def _stream_compute_tasks(self, tasks: list = (), **kw: Any) -> None:
+        """Batch envelope from the scheduler's per-destination coalescer
+        (scheduler/server.py _coalesce_worker_stream_msgs): each inner
+        message is a full compute-task dict with its own stimulus_id.
+        Expansion lands every task in the same payload-boundary
+        handle_stimulus batch, so dep fetches still aggregate."""
+        for msg in tasks:
+            self._stream_compute_task(**msg)
 
     def _stream_free_keys(self, keys: tuple = (), stimulus_id: str = "") -> None:
         self._enqueue_stream_event(

@@ -1,0 +1,362 @@
+"""Batched stimulus→transition engine (docs/batching.md).
+
+The contract under test: the ``*_batch`` entries on ``SchedulerState``
+are drop-in producers of the same ``(recs, client_msgs, worker_msgs)``
+triples as N sequential per-key calls — bit-identical final task states,
+worker assignments, per-destination message multisets, and per-key
+``story`` rows — and the server-side wire coalescer
+(``_coalesce_worker_stream_msgs``) is a pure re-batching whose expansion
+round-trips to the original message list.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from distributed_tpu.graph import Graph, TaskRef, TaskSpec
+from distributed_tpu.scheduler.server import _coalesce_worker_stream_msgs
+from distributed_tpu.scheduler.state import SchedulerState
+
+
+def _noop(*args):
+    return 0
+
+
+def _build_state(n_workers: int, nthreads: int = 1) -> SchedulerState:
+    state = SchedulerState(validate=True, transition_counter_max=200_000)
+    for i in range(n_workers):
+        ws = state.add_worker_state(
+            f"tcp://127.0.0.1:{10000 + i}",
+            nthreads=nthreads,
+            memory_limit=2**30,
+            name=f"w{i}",
+        )
+        state.check_idle_saturated(ws)
+    return state
+
+
+def _random_graph(rng: random.Random, n_tasks: int) -> Graph:
+    """Random DAG over a few prefix families (so some groups go rootish)."""
+    g = Graph()
+    keys: list[str] = []
+    for i in range(n_tasks):
+        fam = f"fam{i % 3}"
+        key = f"{fam}-{i}"
+        n_deps = rng.randint(0, min(2, len(keys)))
+        deps = rng.sample(keys, n_deps) if n_deps else []
+        g.tasks[key] = TaskSpec(_noop, tuple(TaskRef(d) for d in deps))
+        keys.append(key)
+    return g
+
+
+def _freeze(obj):
+    """Hashable canonical form of a message value; opaque leaves (wrapped
+    run_specs, exception objects) compare by identity-independent repr of
+    their type — both engines wrap the SAME underlying objects."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    if isinstance(obj, (str, bytes, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+def _canon(msgs_by_dest: dict) -> dict:
+    """dest -> sorted multiset of frozen messages (run_spec dropped: the
+    wrapper objects differ per call; the key identifies the spec)."""
+    out = {}
+    for dest, msgs in msgs_by_dest.items():
+        frozen = []
+        for m in msgs:
+            m = {k: v for k, v in m.items() if k not in ("run_spec",)}
+            frozen.append(_freeze(m))
+        out[dest] = sorted(frozen, key=repr)
+    return {d: v for d, v in out.items() if v}
+
+
+def _stories(state: SchedulerState) -> list[tuple]:
+    # transition_log rows minus the wall-clock stamp
+    return [row[:5] for row in state.transition_log]
+
+
+def _snapshot(state: SchedulerState) -> dict:
+    return {
+        key: (
+            ts.state,
+            ts.processing_on.address if ts.processing_on else None,
+            tuple(sorted(ws.address for ws in ts.who_has)),
+        )
+        for key, ts in state.tasks.items()
+    }
+
+
+def _processing(state: SchedulerState, addr: str) -> list[str]:
+    return sorted(ts.key for ts in state.workers[addr].processing)
+
+
+FINISH_KW = dict(
+    nbytes=64,
+    typename="int",
+    startstops=[{"action": "compute", "start": 0.0, "stop": 0.01}],
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_tasks_finished_batch_oracle_parity(seed):
+    """Replay an identical stimulus trace through the per-key engine and
+    the batched engine: identical final task states, assignments, message
+    multisets, and per-key stories."""
+    rng = random.Random(seed)
+    n_workers = rng.choice([2, 3, 5])
+    g = _random_graph(rng, 60)
+    g.validate()
+    deps = g.dependencies()
+    roots = [k for k in g.tasks if not any(k in d for d in deps.values())]
+    wanted = list(g.tasks)[-10:]
+
+    oracle = _build_state(n_workers)
+    batched = _build_state(n_workers)
+    for st in (oracle, batched):
+        cm, wm = st.update_graph_core(
+            dict(g.tasks), {k: set(v) for k, v in deps.items()}, wanted,
+            client="client-1", stimulus_id="graph-in",
+        )
+        st.validate_state()
+    del roots
+
+    step = 0
+    for _ in range(400):
+        # a flood: every task currently processing on one random worker
+        # (the engines are asserted identical each round, so both see
+        # the same processing sets)
+        addrs = [a for a in oracle.workers if _processing(oracle, a)]
+        if not addrs:
+            break
+        addr = rng.choice(addrs)
+        keys = _processing(oracle, addr)
+        assert keys == _processing(batched, addr)
+        erred = rng.random() < 0.2
+        step += 1
+        if erred:
+            exc = ValueError(f"boom-{step}")
+            items = [
+                (key, addr, f"err-{step}-{i}",
+                 dict(exception=exc, exception_text="boom"))
+                for i, key in enumerate(keys)
+            ]
+            o_c, o_w = {}, {}
+            for key, w, sid, kw in items:
+                c, wmsg = oracle.stimulus_task_erred(key, w, sid, **kw)
+                for dst, v in c.items():
+                    o_c.setdefault(dst, []).extend(v)
+                for dst, v in wmsg.items():
+                    o_w.setdefault(dst, []).extend(v)
+            b_c, b_w = batched.stimulus_tasks_erred_batch(
+                [(k, w, s, dict(kw)) for k, w, s, kw in items]
+            )
+        else:
+            items = [
+                (key, addr, f"fin-{step}-{i}", dict(FINISH_KW))
+                for i, key in enumerate(keys)
+            ]
+            o_c, o_w = {}, {}
+            for key, w, sid, kw in items:
+                c, wmsg = oracle.stimulus_task_finished(key, w, sid, **kw)
+                for dst, v in c.items():
+                    o_c.setdefault(dst, []).extend(v)
+                for dst, v in wmsg.items():
+                    o_w.setdefault(dst, []).extend(v)
+            b_c, b_w = batched.stimulus_tasks_finished_batch(
+                [(k, w, s, dict(kw)) for k, w, s, kw in items]
+            )
+        assert _canon(o_c) == _canon(b_c)
+        assert _canon(o_w) == _canon(b_w)
+        assert _snapshot(oracle) == _snapshot(batched)
+        oracle.validate_state()
+        batched.validate_state()
+
+    assert _snapshot(oracle) == _snapshot(batched)
+    assert _stories(oracle) == _stories(batched)
+
+
+def test_stale_completion_flood_returns_free_keys():
+    """Completions for unknown/cancelled keys produce one free-keys per
+    stale key, identical to the per-key engine."""
+    state = _build_state(2)
+    addr = next(iter(state.workers))
+    cm, wm = state.stimulus_tasks_finished_batch(
+        [
+            ("ghost-1", addr, "s1", dict(FINISH_KW)),
+            ("ghost-2", addr, "s2", dict(FINISH_KW)),
+        ]
+    )
+    assert cm == {}
+    assert [m["keys"] for m in wm[addr]] == [["ghost-1"], ["ghost-2"]]
+    assert [m["op"] for m in wm[addr]] == ["free-keys", "free-keys"]
+
+
+def test_poison_event_does_not_lose_flood_output():
+    """A malformed event mid-flood is logged and skipped; events before
+    and after it still apply and their messages survive — the
+    sequential per-message path loses only the poison message too."""
+    g = Graph()
+    for i in range(3):
+        g.tasks[f"a-{i}"] = TaskSpec(_noop, ())
+    state = _build_state(3)
+    state.update_graph_core(
+        dict(g.tasks), {k: set() for k in g.tasks}, list(g.tasks),
+        client="c", stimulus_id="in",
+    )
+    items = []
+    for ws in state.workers.values():
+        for ts in list(ws.processing):
+            items.append((ts.key, ws.address))
+    assert len(items) == 3
+    poison = dict(FINISH_KW)
+    poison["startstops"] = ["not-a-dict"]  # AttributeError inside _transition
+    flood = [
+        (items[0][0], items[0][1], "s0", dict(FINISH_KW)),
+        (items[1][0], items[1][1], "s1", poison),
+        (items[2][0], items[2][1], "s2", dict(FINISH_KW)),
+    ]
+    cm, wm = state.stimulus_tasks_finished_batch(flood)
+    assert state.tasks[items[0][0]].state == "memory"
+    assert state.tasks[items[2][0]].state == "memory"
+    # the healthy events' client reports survived the poison event
+    reported = {
+        m["key"] for msgs in cm.values() for m in msgs
+        if m["op"] == "key-in-memory"
+    }
+    assert {items[0][0], items[2][0]} <= reported
+
+
+def test_transitions_batch_generator_interleaves():
+    """transitions_batch consumes its rounds lazily, so a generator can
+    interleave side effects (replica removal) with each round exactly
+    like sequential per-message handling."""
+    g = Graph()
+    g.tasks["a-0"] = TaskSpec(_noop, ())
+    state = _build_state(2)
+    state.update_graph_core(
+        dict(g.tasks), {"a-0": set()}, ["a-0"], client="c",
+        stimulus_id="in",
+    )
+    [(addr, ts)] = [
+        (ws.address, ts)
+        for ws in state.workers.values()
+        for ts in ws.processing
+    ]
+    state.stimulus_task_finished(ts.key, addr, "fin", **FINISH_KW)
+    assert state.tasks["a-0"].state == "memory"
+
+    seen = []
+
+    def rounds():
+        ws = state.tasks["a-0"].who_has and next(iter(state.tasks["a-0"].who_has))
+        state.remove_replica(state.tasks["a-0"], ws)
+        seen.append(state.tasks["a-0"].state)  # still memory: lazy proof
+        yield {"a-0": "released"}, "rel-1"
+
+    cm, wm = state.transitions_batch(rounds())
+    assert seen == ["memory"]
+    assert state.tasks.get("a-0") is None or state.tasks["a-0"].state != "memory"
+    state.validate_state()
+
+
+# ------------------------------------------------------- wire coalescer
+
+
+def _expand(msgs):
+    out = []
+    for m in msgs:
+        if m.get("op") == "compute-tasks":
+            out.extend(m["tasks"])
+        elif m.get("op") == "free-keys":
+            for k in m["keys"]:
+                out.append({**m, "keys": [k]})
+        else:
+            out.append(m)
+    return out
+
+
+def test_coalescer_expansion_roundtrip():
+    msgs = [
+        {"op": "compute-task", "key": "a", "stimulus_id": "s1"},
+        {"op": "compute-task", "key": "b", "stimulus_id": "s2"},
+        {"op": "compute-task", "key": "c", "stimulus_id": "s3"},
+        {"op": "free-keys", "keys": ["x"], "stimulus_id": "s4"},
+        {"op": "free-keys", "keys": ["y"], "stimulus_id": "s4"},
+        {"op": "free-keys", "keys": ["z"], "stimulus_id": "s5"},
+        {"op": "compute-task", "key": "d", "stimulus_id": "s6"},
+        {"op": "remove-replicas", "keys": ["q"], "stimulus_id": "s7"},
+        {"op": "compute-task", "key": "e", "stimulus_id": "s8"},
+    ]
+    orig = [dict(m) for m in msgs]
+    coalesced = _coalesce_worker_stream_msgs(msgs)
+    # compute-task runs fold; ordering relative to other ops preserved
+    ops = [m["op"] for m in coalesced]
+    assert ops == [
+        "compute-tasks", "free-keys", "free-keys", "compute-task",
+        "remove-replicas", "compute-task",
+    ]
+    assert _expand(coalesced) == orig
+
+
+def test_coalescer_never_merges_across_stimuli_or_mutates():
+    shared = {"op": "free-keys", "keys": ["k"], "stimulus_id": "s1"}
+    msgs = [shared, {"op": "free-keys", "keys": ["m"], "stimulus_id": "s1"}]
+    out = _coalesce_worker_stream_msgs(msgs)
+    assert len(out) == 1 and out[0]["keys"] == ["k", "m"]
+    # the SHARED input dict (the state machine reuses one dict across
+    # destinations) must not be mutated by the merge
+    assert shared["keys"] == ["k"]
+    # different stimulus ids never merge (worker-side causal stories)
+    msgs2 = [
+        {"op": "free-keys", "keys": ["a"], "stimulus_id": "s1"},
+        {"op": "free-keys", "keys": ["b"], "stimulus_id": "s2"},
+    ]
+    assert len(_coalesce_worker_stream_msgs(msgs2)) == 2
+
+
+def test_coalescer_short_lists_passthrough():
+    one = [{"op": "compute-task", "key": "a"}]
+    assert _coalesce_worker_stream_msgs(one) is one
+    assert _coalesce_worker_stream_msgs([]) == []
+
+
+# ------------------------------------------------------------ end to end
+
+
+def test_compute_tasks_batch_reaches_worker(monkeypatch):
+    """A fan-out submission crosses the wire as compute-tasks batch
+    envelopes and still computes correctly end to end."""
+    import asyncio
+
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+    from distributed_tpu.worker.server import Worker
+
+    batch_sizes: list[int] = []
+    orig = Worker._stream_compute_tasks
+
+    def spy(self, tasks=(), **kw):
+        batch_sizes.append(len(tasks))
+        return orig(self, tasks=tasks, **kw)
+
+    monkeypatch.setattr(Worker, "_stream_compute_tasks", spy)
+
+    def inc(x):
+        return x + 1
+
+    async def run():
+        async with LocalCluster(n_workers=2, threads_per_worker=4) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                futs = c.map(inc, range(30))
+                return await c.gather(futs)
+
+    results = asyncio.run(asyncio.wait_for(run(), 120))
+    assert results == list(range(1, 31))
+    assert batch_sizes and max(batch_sizes) >= 2

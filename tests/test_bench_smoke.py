@@ -1,0 +1,38 @@
+"""Tier-1 gate for the perf plumbing: ``python bench.py --smoke`` runs
+seconds-scale, CPU-pinned miniatures of the live-path (batched transition
+engine, coalesced streams) and placement-path (chunked pack/upload)
+configs on every PR, so regressions in the bench plumbing itself — the
+round-2 lesson of a bench that died with no parseable output — and in the
+perf-critical code paths it exercises surface in CI instead of only in
+full bench rounds."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+
+def test_bench_smoke_runs_and_reports():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--smoke"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    line = [
+        ln for ln in proc.stdout.splitlines() if ln.strip().startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["smoke"] is True
+    cluster = out["configs"]["cluster"]
+    assert cluster["n_tasks"] > 0
+    assert cluster["overhead_us_per_task"] > 0
+    placement = out["configs"]["placement"]
+    assert placement["n_tasks"] > 0
+    assert placement["n_waves"] > 0

@@ -123,6 +123,63 @@ def test_streamed_compact_valid_and_balanced():
 
 
 @needs_native
+def test_streamed_auto_compact_is_exact_on_cpu():
+    """compact="auto" (the default) disables the lossy wire format on the
+    cpu backend, so the chunked pack/upload overlap is byte-identical to
+    the unchunked path there."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("auto resolves to packed on accelerator backends")
+    rng = np.random.default_rng(21)
+    durations, out_bytes, src, dst = random_dag(rng, 20_000)
+    nthreads, occ0, running = workers(8)
+    packed0 = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    res0 = place_graph_leveled(packed0, nthreads, occ0, running)
+    tm: dict = {}
+    _, res1 = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BW, chunk_rows=6_000, min_stream=1, timings=tm,
+    )
+    assert tm["fmt"] == "f16"
+    np.testing.assert_array_equal(res1.assignment, res0.assignment)
+    np.testing.assert_array_equal(res1.choice, res0.choice)
+
+
+@needs_native
+def test_fused_topo_parity_with_numpy_pack_threaded():
+    """The fused (and, above 2^18 edges, two-threaded) native topo pass
+    must agree with the pure-numpy oracle on every output the placement
+    consumes — including the threaded branch."""
+    rng = np.random.default_rng(22)
+    T = 140_000
+    durations, out_bytes, src, dst = random_dag(rng, T, max_deps=4)
+    assert len(src) >= (1 << 18), "graph too small to exercise the threads"
+    native_pack = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+
+    import distributed_tpu.native as native_mod
+
+    real_load = native_mod.load
+    try:
+        native_mod.load = lambda: None
+        numpy_pack = pack_graph(durations, out_bytes, src, dst, bandwidth=BW)
+    finally:
+        native_mod.load = real_load
+    assert native_pack.n_levels == numpy_pack.n_levels
+    np.testing.assert_array_equal(native_pack.level, numpy_pack.level)
+    np.testing.assert_array_equal(native_pack.perm, numpy_pack.perm)
+    np.testing.assert_array_equal(native_pack.offsets, numpy_pack.offsets)
+    np.testing.assert_array_equal(native_pack.heavy_s, numpy_pack.heavy_s)
+    np.testing.assert_array_equal(native_pack.heavy2_s, numpy_pack.heavy2_s)
+    np.testing.assert_allclose(
+        native_pack.xfer_pref_s, numpy_pack.xfer_pref_s, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        native_pack.xfer_all_s, numpy_pack.xfer_all_s, rtol=1e-5
+    )
+
+
+@needs_native
 def test_streamed_respects_stopped_workers():
     rng = np.random.default_rng(13)
     durations, out_bytes, src, dst = random_dag(rng, 30_000)
