@@ -349,6 +349,7 @@ async def _run_steal(steal_enabled):
     from distributed_tpu.deploy.local import LocalCluster
 
     n_tasks, n_workers, delay = 320, 64, 0.02
+    mirror_stats = None
     with config.set(
         {
             "scheduler.work-stealing": steal_enabled,
@@ -375,8 +376,24 @@ async def _run_steal(steal_enabled):
                 )
                 await c.gather(futs)
                 wall = time.perf_counter() - t0
+                mirror = cluster.scheduler.state.mirror
+                if mirror is not None:
+                    mirror_stats = mirror.stats()
     ideal = n_tasks * delay / n_workers
-    return wall, ideal, n_tasks
+    return wall, ideal, n_tasks, mirror_stats
+
+
+def _host_canary_ms() -> float:
+    """Milliseconds for a fixed pure-python workload: the steal config's
+    walls swing with host load (this box drifts 2x+ through a day —
+    PERF.md Rounds 5-6), so cross-round comparisons of
+    ``balance_efficiency`` are only meaningful normalized by this
+    canary, same role as ``stock_us_per_task`` in the dag_1m entry."""
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(200_000):
+        acc += i % 7
+    return (time.perf_counter() - t0) * 1e3
 
 
 async def cfg_steal():
@@ -390,17 +407,20 @@ async def cfg_steal():
 
     n_runs = max(int(os.environ.get("DTPU_BENCH_STEAL_RUNS", "3")), 3)
     n_runs += 1 - n_runs % 2  # odd, so the median is a real run
+    canary = _host_canary_ms()
     walls = []
     ideal = n_tasks = None
+    mirror_stats = None
     for _ in range(n_runs):
-        wall, ideal, n_tasks = await _run_steal(True)
+        wall, ideal, n_tasks, mstats = await _run_steal(True)
         walls.append(round(wall, 3))
+        mirror_stats = mstats or mirror_stats
     wall = statistics.median(walls)
     # median-of-3 for the baseline too: a single noisy no-steal run
     # against a median steal run would misstate the benefit either way
     walls_off = []
     for _ in range(3):
-        wall_off, _, _ = await _run_steal(False)
+        wall_off, _, _, _ = await _run_steal(False)
         walls_off.append(round(wall_off, 3))
     wall_off = statistics.median(walls_off)
     return {
@@ -413,6 +433,8 @@ async def cfg_steal():
         "wall_s_no_steal_runs": walls_off,
         "ideal_s": round(ideal, 3),
         "balance_efficiency": round(ideal / wall, 3),
+        "host_canary_ms": round(canary, 2),
+        "mirror": mirror_stats,
         "vs_baseline": round(wall_off / wall, 1),
     }
 
@@ -818,6 +840,87 @@ def _smoke_placement() -> dict:
     }
 
 
+def _smoke_mirror() -> dict:
+    """Mirror-fed steal + AMM cycle on a 64-worker synthetic fleet: the
+    persistent SoA mirror (scheduler/mirror.py) feeds both device
+    kernels with zero from-scratch Python packs; raises if a cycle fell
+    back to the oracle pack or re-uploaded the whole fleet."""
+    from distributed_tpu.scheduler.amm import (
+        ActiveMemoryManagerExtension,
+        ReduceReplicas,
+    )
+    from distributed_tpu.scheduler.state import SchedulerState
+    from distributed_tpu.scheduler.stealing import WorkStealing
+    from distributed_tpu.utils.test import StubScheduler
+
+    state = SchedulerState(validate=True)
+    assert state.mirror is not None, "mirror disabled in smoke config"
+    sched = StubScheduler(state)
+    for i in range(64):
+        state.add_worker_state(f"tcp://smoke:{i}", nthreads=1,
+                               memory_limit=2**30, name=f"w{i}")
+    # after the fleet exists: WorkStealing's init registers the per-
+    # worker stealable levels for current workers
+    stealing_ext = WorkStealing(sched)
+    amm = ActiveMemoryManagerExtension(
+        sched, policies=[ReduceReplicas()], register=False, start=False
+    )
+    workers = list(state.workers.values())
+    w0 = workers[0]
+    # steal half: a 200-task pile pinned to w0 (loose restrictions)
+    from distributed_tpu.graph.spec import TaskSpec
+
+    state.new_task_prefix("smk").add_duration(0.05)
+    tasks = {f"smk-{i}": TaskSpec(_inc, (i,)) for i in range(200)}
+    state.update_graph_core(
+        tasks, {k: set() for k in tasks}, list(tasks), client="smoke",
+        annotations_by_key={
+            k: {"workers": [w0.address], "allow_other_workers": True}
+            for k in tasks
+        },
+        stimulus_id="smoke-steal",
+    )
+    idle = [ws for ws in state.idle.values() if ws in state.running]
+    t0 = time.perf_counter()
+    stealing_ext._balance_device(idle)  # no loop: plans inline
+    steal_wall = time.perf_counter() - t0
+    n_steals = len(stealing_ext.in_flight)
+    assert n_steals > 0, "device balance planned no steals"
+    # AMM half: 72 over-replicated keys -> device drop selection
+    for i in range(72):
+        key = f"rep-{i}"
+        state.new_task(key, None).priority = (0,)
+        state._transition(key, "memory", "smoke-amm", nbytes=1_000,
+                          worker=w0.address)
+        for ws in workers[1 + i % 8: 4 + i % 8]:
+            state.add_replica(state.tasks[key], ws)
+    t0 = time.perf_counter()
+    amm.run_once()
+    amm_wall = time.perf_counter() - t0
+    n_drops = sum(
+        len(msg.get("keys", ()))
+        for _, wmsgs in sched.sent
+        for msgs in wmsgs.values()
+        for msg in msgs
+        if msg.get("op") == "remove-replicas"
+    )
+    assert n_drops > 0, "AMM device round dropped nothing"
+    stats = state.mirror.stats()
+    assert stats["oracle_packs"] == 0, stats
+    assert stats["oracle_failures"] == 0, stats
+    # device residency: at most the one initial whole-cache upload
+    assert stats["full_uploads"] <= 1, stats
+    state.mirror.verify()
+    return {
+        "n_workers": 64,
+        "n_steals": n_steals,
+        "n_drops": n_drops,
+        "steal_cycle_s": round(steal_wall, 3),
+        "amm_cycle_s": round(amm_wall, 3),
+        "mirror": stats,
+    }
+
+
 def run_smoke():
     """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
     line on stdout; raises (non-zero exit) on any failure."""
@@ -830,6 +933,7 @@ def run_smoke():
     configs = {
         "cluster": asyncio.run(_smoke_cluster()),
         "placement": _smoke_placement(),
+        "mirror": _smoke_mirror(),
     }
     print(
         json.dumps(

@@ -4,6 +4,7 @@ from distributed_tpu.analysis.rules import (  # noqa: F401
     blocking_async,
     handler_parity,
     jit_purity,
+    mirror_parity,
     monotonic_time,
     sans_io,
     swallowed,

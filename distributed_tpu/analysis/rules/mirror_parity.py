@@ -1,0 +1,167 @@
+"""mirror-parity: mirrored fleet fields change only through mirror-aware
+helpers.
+
+The persistent device mirror (scheduler/mirror.py) maintains per-worker
+SoA rows by DELTAS: every mutation of a mirrored ``WorkerState`` field
+must mark the row dirty, or the incremental arrays silently diverge
+from the from-scratch oracle and the co-processor kernels (placement,
+stealing, AMM, rebalance) plan against stale state.  The state machine
+therefore funnels those mutations through a small registry of
+mirror-aware helpers (``_adjust_occupancy``, the replica model, the
+worker lifecycle, ``set_worker_status``/``set_worker_nthreads``); this
+rule flags any OTHER site in scheduler code that assigns, augments,
+deletes or container-mutates a mirrored field on a worker-state object.
+
+Matching is name-based on the attribute base (``ws``/``wws``/``lws``/
+``vws``/``worker_state`` — the universal WorkerState binding names in
+this codebase — plus ``self`` inside ``class WorkerState`` itself, for
+``__init__``/``clean``).  A legitimate new mutation site either moves
+into a helper, gets added to the registry here (WITH a mirror mark), or
+carries an ``# graft-lint: allow[mirror-parity] reason`` pragma — same
+baseline machinery as the other rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from distributed_tpu.analysis import astutils
+from distributed_tpu.analysis.core import Finding, LintContext, Rule, register
+
+#: mirrored WorkerState fields (scheduler/mirror.py FIELDS + the replica
+#: container feeding ``nbytes``); ``nprocessing`` mirrors
+#: ``len(ws.processing)``, so the processing dict is included
+_SCALAR_FIELDS = frozenset({"occupancy", "nthreads", "nbytes", "status"})
+_CONTAINER_FIELDS = frozenset({"has_what", "processing"})
+#: method calls that mutate a container in place
+_MUTATORS = frozenset({
+    "add", "discard", "remove", "clear", "pop", "popitem", "update",
+    "setdefault", "append", "extend",
+})
+#: names a scheduler-side WorkerState binding goes by
+_WS_NAMES = frozenset({"ws", "wws", "lws", "vws", "worker_state"})
+
+#: the mirror-aware registry: enclosing functions allowed to mutate
+#: mirrored fields (each either marks the mirror row or runs before the
+#: worker is registered / after it is tombstoned)
+_ALLOWED_FUNCS = frozenset({
+    "__init__",              # WorkerState construction (idx not assigned yet)
+    "clean",                 # detached diagnostics copy, never registered
+    "_adjust_occupancy",
+    "_exit_processing_common",
+    "_add_to_processing",
+    "_clear_task_state",
+    "add_replica",
+    "remove_replica",
+    "remove_all_replicas",
+    "update_nbytes",
+    "add_worker_state",
+    "remove_worker_state",
+    "set_worker_status",
+    "set_worker_nthreads",
+})
+
+
+def _is_worker_base(node: ast.expr, ws_classes: set[str],
+                    func_name: str) -> bool:
+    """Does ``node`` look like a WorkerState object?"""
+    if isinstance(node, ast.Name):
+        if node.id in _WS_NAMES:
+            return True
+        if node.id == "self" and func_name in ws_classes:
+            return True
+    return False
+
+
+@register
+class MirrorParityRule(Rule):
+    name = "mirror-parity"
+    description = (
+        "mirrored WorkerState fields (occupancy/nthreads/nbytes/status/"
+        "has_what/processing) mutate only inside mirror-aware helpers"
+    )
+    # the mirror's delta sources live in the scheduler package; worker-
+    # side state machines keep their own unrelated fields of the same
+    # names
+    scope = ("distributed_tpu/scheduler/**",)
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for mod in ctx.modules(self):
+            astutils.add_parents(mod.tree)
+            # method names defined on WorkerState in this module (so
+            # ``self.<field> = ...`` inside them is recognized)
+            ws_methods: set[str] = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) and node.name == "WorkerState":
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            ws_methods.add(item.name)
+            for node in ast.walk(mod.tree):
+                hit = self._mutation(node, ws_methods)
+                if hit is None:
+                    continue
+                field, kind = hit
+                fn = astutils.enclosing_function_name(node)
+                if fn.rsplit(".", 1)[-1] in _ALLOWED_FUNCS:
+                    continue
+                yield Finding(
+                    rule=self.name, path=mod.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{kind} of mirrored field `{field}` outside the "
+                        f"mirror-aware helpers — route through "
+                        f"SchedulerState (set_worker_status/"
+                        f"set_worker_nthreads/_adjust_occupancy/replica "
+                        f"model) or mark the mirror row, then register "
+                        f"the helper in analysis/rules/mirror_parity.py"
+                    ),
+                    symbol=fn,
+                )
+
+    @staticmethod
+    def _mutation(node: ast.AST, ws_methods: set[str]) -> tuple[str, str] | None:
+        """(field, kind) when ``node`` mutates a mirrored field."""
+
+        def worker_attr(expr: ast.expr, fields) -> str | None:
+            if (
+                isinstance(expr, ast.Attribute)
+                and expr.attr in fields
+                and _is_worker_base(
+                    expr.value, ws_methods,
+                    astutils.enclosing_function_name(expr).rsplit(".", 1)[-1],
+                )
+            ):
+                return expr.attr
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                # ws.field = ... / ws.field += ...
+                f = worker_attr(tgt, _SCALAR_FIELDS | _CONTAINER_FIELDS)
+                if f is not None:
+                    return f, "assignment"
+                # ws.container[...] = ...
+                if isinstance(tgt, ast.Subscript):
+                    f = worker_attr(tgt.value, _CONTAINER_FIELDS)
+                    if f is not None:
+                        return f, "item assignment"
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    f = worker_attr(tgt.value, _CONTAINER_FIELDS)
+                    if f is not None:
+                        return f, "item deletion"
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATORS
+            ):
+                f = worker_attr(func.value, _CONTAINER_FIELDS)
+                if f is not None:
+                    return f, f"in-place `{func.attr}`"
+        return None

@@ -100,6 +100,12 @@ defaults: dict[str, Any] = {
             "min-transfer-ratio": 0.02,
             "capacity-doubling": True,  # grow SoA arrays by 2x
             "parity-check": False,      # run python oracle in lockstep (tests)
+            # persistent fleet SoA mirror (scheduler/mirror.py): delta-
+            # maintained per-worker arrays shared by every co-processor
+            # kernel; off = every cycle rebuilds its snapshot from
+            # scratch (the oracle pack).  DTPU_MIRROR_CHECK=1 verifies
+            # the mirror against that oracle on every view.
+            "mirror": True,
         },
         "active-memory-manager": {
             "start": True,

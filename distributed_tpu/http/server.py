@@ -184,6 +184,35 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                 help_="Confirmed task steals", type_="counter",
             )
         )
+    mirror = getattr(s, "mirror", None)
+    if mirror is not None:
+        # fleet-mirror health (scheduler/mirror.py): a production
+        # regression — a consumer silently falling back to from-scratch
+        # packs, upload volume creeping back toward O(W), oracle-check
+        # failures — is observable here, not only on the bench
+        gauges = ("generation", "capacity", "dirty_high_water")
+        counters = (
+            "deltas_applied", "rows_refreshed", "rows_uploaded",
+            "bytes_uploaded", "full_uploads", "membership_rebuilds",
+            "oracle_checks", "oracle_failures", "oracle_packs",
+        )
+        stats = mirror.stats()
+        for name in gauges:
+            lines.append(
+                prom_line(
+                    f"dtpu_mirror_{name}", stats[name],
+                    help_=f"Fleet mirror {name.replace('_', ' ')}",
+                    type_="gauge",
+                )
+            )
+        for name in counters:
+            lines.append(
+                prom_line(
+                    f"dtpu_mirror_{name}_total", stats[name],
+                    help_=f"Fleet mirror {name.replace('_', ' ')}",
+                    type_="counter",
+                )
+            )
     return ("\n".join(lines) + "\n").encode()
 
 

@@ -101,7 +101,13 @@ def _steal_rounds(
         #    320-task pile 8 tasks per cycle (balance_efficiency 0.5).
         key = jnp.where(taken[:T], IMAX, task_key)
         vload = occ / threads
-        usable = (key != IMAX) & running[task_victim]
+        # victims are NOT masked on running: a paused worker keeps its
+        # pile and the scheduler re-marks its homed tasks stealable so
+        # the balancer can drain it (the python path includes paused
+        # victims too); a victim REMOVED after the snapshot costs
+        # nothing — the apply step re-validates ``processing_on``.
+        # ``running`` gates only thief eligibility below.
+        usable = key != IMAX
         order = jnp.lexsort(
             (key, jnp.where(usable, -vload[task_victim], jnp.inf))
         )

@@ -103,11 +103,13 @@ class ActiveMemoryManagerExtension:
 
     def run_once(self) -> None:
         stimulus_id = seq_name("amm")
-        # projected memory per worker for this round: actual managed bytes
-        # plus/minus the round's own decisions (reference amm.py:~200)
-        self.workers_memory = {
-            ws: ws.nbytes for ws in self.state.workers.values()
-        }
+        # projected memory per worker for this round: actual managed
+        # bytes plus/minus the round's own decisions (reference
+        # amm.py:~200).  Kept as an OVERLAY over live ``ws.nbytes``
+        # (``_projected``) instead of a pre-seeded dict: the old
+        # ``{ws: ws.nbytes for ws in workers}`` was an O(W) Python loop
+        # per 2 s round, paid even when no policy suggested anything.
+        self.workers_memory = {}
         try:
             # pending[ts] -> (set of recipients, set of droppers)
             self.pending = {}
@@ -154,6 +156,12 @@ class ActiveMemoryManagerExtension:
             self.pending = {}
             self.workers_memory = {}
 
+    def _projected(self, ws: "WorkerState") -> float:
+        """This round's projected managed memory: live bytes overlaid
+        with the round's own pending decisions."""
+        mem = self.workers_memory.get(ws)
+        return ws.nbytes if mem is None else mem
+
     def _handle_suggestion(self, cmd: Suggestion) -> None:
         op, ts, candidates = cmd
         recipients, droppers = self.pending.setdefault(ts, (set(), set()))
@@ -162,14 +170,14 @@ class ActiveMemoryManagerExtension:
             if ws is not None:
                 recipients.add(ws)
                 self.workers_memory[ws] = (
-                    self.workers_memory.get(ws, 0) + ts.get_nbytes()
+                    self._projected(ws) + ts.get_nbytes()
                 )
         elif op == "drop":
             ws = self._find_dropper(ts, candidates, recipients, droppers)
             if ws is not None:
                 droppers.add(ws)
                 self.workers_memory[ws] = max(
-                    0, self.workers_memory.get(ws, 0) - ts.get_nbytes()
+                    0, self._projected(ws) - ts.get_nbytes()
                 )
 
     def _find_recipient(self, ts: "TaskState", candidates, pending_repl
@@ -186,7 +194,7 @@ class ActiveMemoryManagerExtension:
         candidates -= pending_repl
         if not candidates:
             return None
-        return min(candidates, key=lambda ws: self.workers_memory.get(ws, 0))
+        return min(candidates, key=self._projected)
 
     def _find_dropper(self, ts: "TaskState", candidates, pending_repl,
                       pending_drop) -> "WorkerState | None":
@@ -208,7 +216,7 @@ class ActiveMemoryManagerExtension:
         }
         if not candidates:
             return None
-        return max(candidates, key=lambda ws: self.workers_memory.get(ws, 0))
+        return max(candidates, key=self._projected)
 
 
 class ActiveMemoryManagerPolicy:
@@ -265,15 +273,21 @@ class ReduceReplicas(ActiveMemoryManagerPolicy):
     def _run_device(self, replicated: list) -> Generator[Suggestion, None, None]:
         """Whole-round drop selection in one device call
         (ops/amm.py); each emitted suggestion pins its chosen holder and
-        still passes through _find_dropper's guards."""
+        still passes through _find_dropper's guards.
+
+        The worker axis is the persistent mirror's slot space when a
+        mirror exists (replica columns come straight from
+        ``WorkerState.idx``, the projected-memory vector from the
+        delta-maintained ``nbytes`` row — no per-round worker dict or
+        O(W) Python pack); tombstone slots are never holders, so the
+        kernel cannot select them.  Without a mirror the original dense
+        pack below stays as the oracle path."""
         import numpy as np
 
         from distributed_tpu.ops import amm as ops_amm
 
         state = self.manager.state
-        workers = list(state.workers.values())
-        widx = {ws: i for i, ws in enumerate(workers)}
-        W = len(workers)
+        mirror = state.mirror
         rows = []
         for ts in replicated:
             ndrop = len(ts.who_has) - self._desired(ts)
@@ -282,29 +296,47 @@ class ReduceReplicas(ActiveMemoryManagerPolicy):
         if not rows:
             return
         R = len(rows)
+        if mirror is not None:
+            fv = mirror.fleet_view()
+            W = mirror.cap
+            ws_of = fv.ws_of
+            slot = lambda ws: ws.idx  # noqa: E731
+            mem = fv.nbytes.astype(np.float32, copy=True)
+            for ws, v in self.manager.workers_memory.items():
+                if ws.idx >= 0:
+                    mem[ws.idx] = v
+        else:
+            workers = list(state.workers.values())
+            widx = {ws: i for i, ws in enumerate(workers)}
+            W = len(workers)
+            ws_of = workers
+            slot = lambda ws: widx.get(ws, -1)  # noqa: E731
+            mem = np.asarray(
+                [self.manager._projected(ws) for ws in workers], np.float32
+            )
         holders = np.zeros((R, W), bool)
         excluded = np.zeros((R, W), bool)
         nbytes = np.zeros(R, np.float32)
         ndrops = np.zeros(R, np.int32)
         for r, (ts, ndrop) in enumerate(rows):
             for ws in ts.who_has:
-                i = widx.get(ws)
-                if i is not None:
+                i = slot(ws)
+                if i >= 0:
                     holders[r, i] = True
             for waiter in ts.waiters:
                 pw = waiter.processing_on
-                if pw is not None and pw in widx:
-                    excluded[r, widx[pw]] = True
+                if pw is not None:
+                    i = slot(pw)
+                    if i >= 0:
+                        excluded[r, i] = True
             nbytes[r] = ts.get_nbytes()
             ndrops[r] = ndrop
-        mem = np.asarray(
-            [self.manager.workers_memory.get(ws, ws.nbytes) for ws in workers],
-            np.float32,
-        )
         for r, w in ops_amm.plan_drops(
             ops_amm.DropBatch(holders, excluded, nbytes, ndrops, mem)
         ):
-            yield ("drop", rows[r][0], {workers[w]})
+            dropper = ws_of[w]
+            if dropper is not None:
+                yield ("drop", rows[r][0], {dropper})
 
 
 class RetireWorker(ActiveMemoryManagerPolicy):

@@ -386,8 +386,7 @@ class JaxPlacement:
                 batch.append(ts)
         if len(batch) < self.min_batch or len(batch) > self.max_batch:
             return 0
-        workers = [ws for ws in state.workers.values()]
-        if len(workers) < max(self.min_workers, 2):
+        if len(state.workers) < max(self.min_workers, 2):
             return 0
         # PRIORITY order is load-bearing: the partitioner's block init
         # chunks this axis, and scheduler priorities are depth-first
@@ -410,7 +409,7 @@ class JaxPlacement:
             # default would otherwise veto planning for every
             # first-of-its-kind graph exactly when the plan matters.
             return 0
-        snapshot = self._snapshot(state, batch, workers, durations, out_bytes)
+        snapshot = self._snapshot(state, batch, durations, out_bytes)
 
         try:
             loop = asyncio.get_running_loop() if not self.sync else None
@@ -516,10 +515,19 @@ class JaxPlacement:
             out_bytes[i] = nbytes if nbytes and nbytes > 0 else _DEFAULT_NBYTES
         return durations, out_bytes, known / max(n, 1)
 
-    def _snapshot(self, state: "SchedulerState", batch: list, workers: list,
+    def _snapshot(self, state: "SchedulerState", batch: list,
                   durations, out_bytes):
         """Synchronous SoA snapshot of the batch + worker fleet (the
-        TaskState graph must not be touched off-loop)."""
+        TaskState graph must not be touched off-loop).
+
+        The fleet half comes from the persistent mirror when available
+        (scheduler/mirror.py): slot-indexed capacity-sized arrays with
+        tombstone rows carrying ``running=False``/``nthreads=0`` — both
+        device engines already mask on exactly those bits — copied
+        because the planner thread reads them while the loop keeps
+        mutating the live buffers.  Cost: O(dirty) refresh + numpy
+        copies, no per-worker Python loop.  Without a mirror the
+        from-scratch pack below remains the oracle path."""
         import numpy as np
 
         index = {ts.key: i for i, ts in enumerate(batch)}
@@ -532,10 +540,23 @@ class JaxPlacement:
                 if j is not None:
                     src.append(j)
                     dst.append(i)
-        nthreads = np.asarray([ws.nthreads for ws in workers], np.int32)
-        occupancy = np.asarray([ws.occupancy for ws in workers], np.float32)
-        running = np.asarray([ws in state.running for ws in workers], bool)
-        addrs = [ws.address for ws in workers]
+        mirror = state.mirror
+        if mirror is not None:
+            fv = mirror.fleet_view()
+            nthreads = fv.nthreads.copy()
+            occupancy = fv.occupancy.copy()
+            running = fv.running.copy()
+            addrs = list(fv.addrs)
+        else:
+            workers = list(state.workers.values())
+            nthreads = np.asarray([ws.nthreads for ws in workers], np.int32)
+            occupancy = np.asarray(
+                [ws.occupancy for ws in workers], np.float32
+            )
+            running = np.asarray(
+                [ws in state.running for ws in workers], bool
+            )
+            addrs = [ws.address for ws in workers]
         return (
             keys, durations, out_bytes,
             np.asarray(src, np.int32), np.asarray(dst, np.int32),

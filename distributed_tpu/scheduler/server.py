@@ -878,6 +878,7 @@ class Scheduler(Server):
         occ = ws.processing.get(ts)
         if occ is not None:
             self.state._adjust_occupancy(ws, -occ)
+            # graft-lint: allow[mirror-parity] row marked by the _adjust_occupancy above and the check_idle_saturated below
             ws.processing[ts] = 0.0
         ws.long_running.add(ts)
         self.state.check_idle_saturated(ws)
@@ -933,13 +934,13 @@ class Scheduler(Server):
         ws = self.state.workers.get(worker)
         if ws is None:
             return
-        if status_seq >= 0:
-            if status_seq < ws.status_seq:
-                # stale stream message ordered behind a fresher flip
-                # (possible after a heartbeat-applied reconciliation)
-                return
-            ws.status_seq = status_seq
-        ws.status = status
+        if status_seq >= 0 and status_seq < ws.status_seq:
+            # stale stream message ordered behind a fresher flip
+            # (possible after a heartbeat-applied reconciliation)
+            return
+        self.state.set_worker_status(
+            ws, status, status_seq if status_seq >= 0 else None
+        )
         ws.status_changed_at = time()
         if status == "paused":
             self.state.running.discard(ws)
@@ -1437,10 +1438,26 @@ class Scheduler(Server):
         its replica.
         """
         s = self.state
-        wss = [
-            s.workers[w] for w in (workers or list(s.workers))
-            if w in s.workers
-        ]
+        mirror = s.mirror
+        if mirror is not None and workers is None:
+            # whole-fleet rebalance: the worker list and the projected-
+            # memory vector come from the persistent mirror (slot-order
+            # live list, O(dirty) refresh + one numpy gather) instead of
+            # a per-call Python pack.  Explicit worker subsets (admin
+            # RPC) keep the from-scratch path below.
+            import numpy as np
+
+            fv = mirror.fleet_view()
+            wss = fv.live_list
+            mem = fv.nbytes[fv.slots].astype(np.float32, copy=True)
+        else:
+            wss = [
+                s.workers[w] for w in (workers or list(s.workers))
+                if w in s.workers
+            ]
+            mem = None
+            if mirror is not None:
+                mirror.oracle_packs += 1
         if len(wss) < 2:
             return {"status": "OK", "moves": 0}
         keyset = set(keys) if keys is not None else None
@@ -1464,7 +1481,7 @@ class Scheduler(Server):
                 owner.append(wi)
         if device_dispatch_worthwhile(len(wss), len(cand), min_items=512,
                                       periodic=True):
-            moves = self._rebalance_plan_device(wss, cand, owner)
+            moves = self._rebalance_plan_device(wss, cand, owner, mem)
         else:
             moves = self._rebalance_plan_python(wss, keyset)
 
@@ -1539,11 +1556,13 @@ class Scheduler(Server):
 
     @staticmethod
     def _rebalance_plan_device(
-        wss: list, cand: list, owner: list[int]
+        wss: list, cand: list, owner: list[int], mem=None
     ) -> list[tuple]:
         """Vectorized move selection via the device kernel
         (ops/rebalance.py): same invariants, Jacobi rounds instead of
-        the sequential greedy loop."""
+        the sequential greedy loop.  ``mem`` is the mirror's projected-
+        memory gather when available; the list-comprehension pack stays
+        as the no-mirror oracle."""
         import numpy as np
 
         from distributed_tpu.ops.rebalance import (
@@ -1553,11 +1572,13 @@ class Scheduler(Server):
 
         if not cand:
             return []
+        if mem is None:
+            mem = np.asarray([ws.nbytes for ws in wss], np.float32)
         batch = RebalanceBatch(
             owner=np.asarray(owner, np.int32),
             nbytes=np.asarray([ts.get_nbytes() for ts in cand], np.float32),
             eligible=np.ones(len(cand), bool),
-            mem=np.asarray([ws.nbytes for ws in wss], np.float32),
+            mem=mem,
         )
         return [
             (cand[key_idx], wss[src], wss[dst])

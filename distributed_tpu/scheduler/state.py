@@ -421,6 +421,7 @@ class SchedulerState:
         validate: bool | None = None,
         transition_counter_max: int | None = None,
         placement: Any | None = None,
+        mirror: bool | None = None,
     ):
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
@@ -506,6 +507,19 @@ class SchedulerState:
         self.n_tasks = 0
         self.plugins: dict[str, Any] = {}
         self.placement = placement  # JAX co-processor hook (ops/placement.py)
+        # persistent fleet SoA shared by every co-processor kernel
+        # (scheduler/mirror.py); None = consumers use the from-scratch
+        # Python pack (the oracle) every cycle
+        self.mirror: Any | None = None
+        if mirror if mirror is not None else config.get("scheduler.jax.mirror", True):
+            from distributed_tpu.scheduler.mirror import SchedulerMirror
+
+            self.mirror = SchedulerMirror(
+                self,
+                capacity_doubling=bool(
+                    config.get("scheduler.jax.capacity-doubling")
+                ),
+            )
         self.extensions: dict[str, Any] = {}
         self.events_subscriber_hook: Callable | None = None
         self.events: defaultdict[str, deque] = defaultdict(
@@ -1675,6 +1689,12 @@ class SchedulerState:
 
     def check_idle_saturated(self, ws: WorkerState, occ: float | None = None) -> None:
         """Update the idle/saturated sets (reference scheduler.py:2949)."""
+        # callers reach here after any occupancy/processing change, so
+        # this is the mirror's cheapest single choke point — mark before
+        # the early return (the return skips set updates, not mutations
+        # the caller already made)
+        if self.mirror is not None:
+            self.mirror.mark(ws)
         if self.total_nthreads == 0 or ws.status == WORKER_STATUS_CLOSED:
             return
         if occ is None:
@@ -1707,6 +1727,8 @@ class SchedulerState:
     def _adjust_occupancy(self, ws: WorkerState, delta: float) -> None:
         ws.occupancy = max(0.0, ws.occupancy + delta)
         self._total_occupancy = max(0.0, self._total_occupancy + delta)
+        if self.mirror is not None:
+            self.mirror.mark(ws)
 
     def _task_slots_available(self, ws: WorkerState) -> int:
         """Open slots below the saturation threshold (reference scheduler.py:8762)."""
@@ -1844,6 +1866,8 @@ class SchedulerState:
         ts.who_has.add(ws)
         if len(ts.who_has) == 2:
             self.replicated_tasks.add(ts)
+        if self.mirror is not None:
+            self.mirror.mark(ws)
 
     def remove_replica(self, ts: TaskState, ws: WorkerState) -> None:
         ws.nbytes -= ts.get_nbytes()
@@ -1851,12 +1875,17 @@ class SchedulerState:
         ts.who_has.discard(ws)
         if len(ts.who_has) == 1:
             self.replicated_tasks.discard(ts)
+        if self.mirror is not None:
+            self.mirror.mark(ws)
 
     def remove_all_replicas(self, ts: TaskState) -> None:
         nbytes = ts.get_nbytes()
+        mirror = self.mirror
         for ws in ts.who_has:
             ws.nbytes -= nbytes
             del ws.has_what[ts]
+            if mirror is not None:
+                mirror.mark(ws)
         if len(ts.who_has) > 1:
             self.replicated_tasks.discard(ts)
         ts.who_has.clear()
@@ -1868,8 +1897,11 @@ class SchedulerState:
             ts.group.nbytes_total += diff
         if ts.prefix is not None:
             ts.prefix.nbytes_total += diff
+        mirror = self.mirror
         for ws in ts.who_has:
             ws.nbytes += diff
+            if mirror is not None:
+                mirror.mark(ws)
         ts.nbytes = nbytes
 
     # ------------------------------------------------------- events
@@ -2166,10 +2198,34 @@ class SchedulerState:
         self.running.add(ws)
         self.total_nthreads += nthreads
         self.total_nthreads_history.append((time(), self.total_nthreads))
+        if self.mirror is not None:
+            self.mirror.on_add_worker(ws)
         self.check_idle_saturated(ws)
         if self.placement is not None:
             self.placement.on_add_worker(self, ws)
         return ws
+
+    def set_worker_status(
+        self, ws: WorkerState, status: str, status_seq: int | None = None
+    ) -> None:
+        """Mirror-aware status mutation (running/idle membership updates
+        stay at the callers — server.handle_worker_status_change owns
+        the transition side effects)."""
+        ws.status = status
+        if status_seq is not None:
+            ws.status_seq = status_seq
+        if self.mirror is not None:
+            self.mirror.mark(ws)
+
+    def set_worker_nthreads(self, ws: WorkerState, nthreads: int) -> None:
+        """Mirror-aware worker resize.  No production message resizes a
+        live worker yet (reconnect is remove+add); this is the designated
+        funnel for when one does, and the churn property tests drive it
+        so the mirror's resize delta path stays proven."""
+        self.total_nthreads += nthreads - ws.nthreads
+        ws.nthreads = nthreads
+        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self.check_idle_saturated(ws)
 
     def bulk_schedule_unrunnable_after_adding_worker(self, ws: WorkerState) -> dict[Key, str]:
         """Try no-worker tasks on the new worker (reference scheduler.py:3173)."""
@@ -2213,6 +2269,8 @@ class SchedulerState:
         ws.occupancy = 0.0
         for r in ws.resources:
             self.resources[r].pop(address, None)
+        if self.mirror is not None:
+            self.mirror.on_remove_worker(ws)
         if self.placement is not None:
             self.placement.on_remove_worker(self, ws)
         # tasks parked for the dead worker become globally poppable again

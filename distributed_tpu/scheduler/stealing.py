@@ -440,11 +440,21 @@ class WorkStealing:
     DEVICE_MAX_VICTIMS = 32
     DEVICE_MAX_TASKS = 8192
 
+    # bounds on the thief-resident byte scan (event-loop work): skip
+    # very wide tasks (the missing remainder dominates the price
+    # anyway), and skip deps replicated past the holder cap (per-dep
+    # scans are memoized per cycle, so total cost is
+    # O(distinct deps x capped holders) + O(tasks x deps) combines)
+    DEVICE_RESIDENT_SCAN_MAX_DEPS = 32
+    DEVICE_RESIDENT_SCAN_MAX_HOLDERS = 16
+
     def _balance_device(self, idle_workers: list) -> None:
         """One balance cycle via the device kernel (ops/stealing.py):
-        SoA snapshot -> K-round jitted selection -> the same
-        move_task_request confirm protocol, with per-move safety
-        re-checks (restrictions, liveness) on the way out."""
+        fleet arrays from the persistent mirror (O(dirty) refresh; the
+        from-scratch pack below stays as the no-mirror oracle path) ->
+        K-round jitted selection -> the same move_task_request confirm
+        protocol, with per-move safety AND criterion re-checks
+        (restrictions, liveness, true comm cost) on the way out."""
         import numpy as np
 
         from distributed_tpu.ops import stealing as ops_stealing
@@ -452,33 +462,92 @@ class WorkStealing:
 
         max_rank = (1 << _RANK_BITS) - 1
         s = self.state
-        workers = list(s.workers.values())
-        widx = {ws.address: i for i, ws in enumerate(workers)}
-        idle_set = set(idle_workers)
+        mirror = s.mirror
+        overlay_slots: list[int] = []
+        overlay_vals: list[float] = []
+        if mirror is not None:
+            fv = mirror.fleet_view()
+            nthreads_arr = fv.nthreads
+            running_arr = fv.running
+            idle_arr = fv.idle
+            nprocessing = fv.nprocessing
+            # snapshot, not the live list: the plan lands asynchronously
+            # and tombstone slots can be REUSED by joiners meanwhile — a
+            # reused slot must resolve to the worker the kernel priced
+            # (whose liveness the apply step then re-checks), never to
+            # the substitute
+            ws_of: list = list(fv.ws_of)
+            for w, extra in self.in_flight_occupancy.items():
+                if w.idx >= 0:
+                    overlay_slots.append(w.idx)
+                    overlay_vals.append(extra)
+            if overlay_slots:
+                occ_arr = fv.occupancy.copy()
+                occ_arr[overlay_slots] += overlay_vals
+            else:
+                occ_arr = fv.occupancy
+            slot_of = None  # WorkerState.idx IS the slot
+        else:
+            # from-scratch oracle pack: the pre-mirror O(W) Python loops
+            workers = list(s.workers.values())
+            idle_set = set(idle_workers)
+            slot_of = {ws.address: i for i, ws in enumerate(workers)}
+            ws_of = workers
+            occ_arr = np.asarray(
+                [self._combined_occupancy(ws) for ws in workers], np.float32
+            )
+            nthreads_arr = np.asarray(
+                [ws.nthreads for ws in workers], np.int32
+            )
+            idle_arr = np.asarray(
+                [ws in idle_set for ws in workers], bool
+            )
+            running_arr = np.asarray(
+                [ws in s.running for ws in workers], bool
+            )
+            nprocessing = np.asarray(
+                [len(ws.processing) for ws in workers], np.int32
+            )
 
         if s.saturated:
-            victim_addrs = [ws.address for ws in s.saturated]
-        else:
-            victim_addrs = [
-                ws.address
-                for ws in sorted(
-                    (w for w in workers if w.processing and w not in idle_set),
-                    key=lambda w: w.occupancy / max(w.nthreads, 1),
-                    reverse=True,
-                )
+            victim_slots = [
+                ws.idx if slot_of is None else slot_of.get(ws.address, -1)
+                for ws in s.saturated
             ]
-        victim_addrs = victim_addrs[: self.DEVICE_MAX_VICTIMS]
+            victim_slots = [v for v in victim_slots if v >= 0]
+        else:
+            vload = occ_arr / np.maximum(nthreads_arr, 1)
+            # NOT filtered on running: a paused worker keeps its pile and
+            # the pause handler re-marks its homed tasks stealable
+            # precisely so this balancer drains them (server.py
+            # handle_worker_status_change) — same as the python path.
+            # Tombstone slots are excluded by nprocessing == 0.
+            cand = np.flatnonzero((nprocessing > 0) & ~idle_arr)
+            victim_slots = cand[
+                np.argsort(-vload[cand], kind="stable")
+            ].tolist()
+        victim_slots = victim_slots[: self.DEVICE_MAX_VICTIMS]
 
         tasks: list = []
         victim_idx: list[int] = []
         keys: list[int] = []
         costs: list[float] = []
         computes: list[float] = []
+        alt_thief: list[int] = []
         rank = 0
-        for addr in victim_addrs:
-            levels = self.stealable.get(addr)
-            vi = widx.get(addr)
-            if levels is None or vi is None:
+        scan_cap = self.DEVICE_RESIDENT_SCAN_MAX_DEPS
+        holder_cap = self.DEVICE_RESIDENT_SCAN_MAX_HOLDERS
+        bandwidth = s.bandwidth
+        # per-dependency idle-holder bytes, computed ONCE per distinct
+        # dep this cycle: a victim's pile usually shares its few inputs,
+        # and without the memo the holder scan repeats per task
+        dep_memo: dict[Any, dict[int, float]] = {}
+        for vi in victim_slots:
+            vws = ws_of[int(vi)]
+            if vws is None:
+                continue
+            levels = self.stealable.get(vws.address)
+            if levels is None:
                 continue
             if rank >= self.DEVICE_MAX_TASKS:
                 break
@@ -486,31 +555,101 @@ class WorkStealing:
                 for ts in list(tset):
                     if rank >= self.DEVICE_MAX_TASKS:
                         break
-                    if ts.key in self.in_flight or ts.processing_on is None \
-                            or ts.processing_on.address != addr:
+                    if ts.key in self.in_flight \
+                            or ts.processing_on is not vws:
                         tset.discard(ts)
                         continue
                     compute = s.get_task_duration(ts)
-                    nbytes = sum(d.get_nbytes() for d in ts.dependencies)
+                    # comm-cost fidelity: the scalar kernel cost used to
+                    # assume NO dependency is resident on any thief —
+                    # over-estimating by exactly the bytes an idle thief
+                    # already holds, which wrongly rejects profitable
+                    # steals toward data (the python oracle's
+                    # get_comm_cost subtracts them).  Use the replica
+                    # slices to price the BEST idle thief (an achievable
+                    # lower bound, achieved by ``alt``); the apply step
+                    # re-checks the criterion with the true per-thief
+                    # cost and falls back to ``alt`` when the rank-
+                    # matched thief can't pay it.
+                    nbytes = 0.0
+                    best_slot = -1
+                    if len(ts.dependencies) <= scan_cap:
+                        resident: dict[int, float] = {}
+                        for d in ts.dependencies:
+                            nb = d.get_nbytes()
+                            nbytes += nb
+                            per_dep = dep_memo.get(d)
+                            if per_dep is None:
+                                per_dep = {}
+                                # widely-replicated deps are skipped
+                                # (counted fully missing — the old
+                                # conservative price): the scan must
+                                # stay O(small) per distinct dep on the
+                                # event loop
+                                if len(d.who_has) <= holder_cap:
+                                    for h in d.who_has:
+                                        hi = (
+                                            h.idx if slot_of is None
+                                            else slot_of.get(h.address, -1)
+                                        )
+                                        if hi >= 0 and idle_arr[hi]:
+                                            per_dep[hi] = nb
+                                dep_memo[d] = per_dep
+                            for hi, hb in per_dep.items():
+                                resident[hi] = resident.get(hi, 0.0) + hb
+                        best_bytes = 0.0
+                        for hi, rb in resident.items():
+                            if rb > best_bytes:
+                                best_bytes, best_slot = rb, hi
+                        nbytes -= best_bytes
+                    else:
+                        nbytes = float(
+                            sum(d.get_nbytes() for d in ts.dependencies)
+                        )
                     tasks.append(ts)
-                    victim_idx.append(vi)
+                    victim_idx.append(int(vi))
                     keys.append((level << _RANK_BITS) | min(rank, max_rank))
-                    costs.append(nbytes / s.bandwidth + LATENCY)
+                    costs.append(nbytes / bandwidth + LATENCY)
                     computes.append(compute)
+                    alt_thief.append(best_slot)
                     rank += 1
         if not tasks:
             return
+        occ_kernel: Any = np.asarray(occ_arr, np.float32)
+        nthreads_kernel: Any = nthreads_arr
+        idle_kernel: Any = idle_arr
+        running_kernel: Any = running_arr
+        if mirror is not None:
+            # device-resident fleet half: the cached arrays re-upload
+            # only rows dirtied since the last cycle — a fresh mirror
+            # dispatches the kernel with ZERO fleet H2D traffic.  The
+            # in-flight overlay (usually empty) lands as an O(#in-flight)
+            # device-side scatter-add.
+            dv = mirror.device_view(
+                ("nthreads", "occupancy", "running", "idle")
+            )
+            if dv is not None:
+                occ_kernel = dv["occupancy"]
+                if overlay_slots:
+                    import jax.numpy as jnp
+
+                    occ_kernel = occ_kernel.at[
+                        jnp.asarray(np.asarray(overlay_slots, np.int32))
+                    ].add(
+                        jnp.asarray(np.asarray(overlay_vals, np.float32))
+                    )
+                nthreads_kernel = dv["nthreads"]
+                idle_kernel = dv["idle"]
+                running_kernel = dv["running"]
         batch = ops_stealing.StealBatch(
             task_victim=np.asarray(victim_idx, np.int32),
             task_key=np.asarray(keys, np.int32),
             task_cost=np.asarray(costs, np.float32),
             task_compute=np.asarray(computes, np.float32),
-            occ=np.asarray(
-                [self._combined_occupancy(ws) for ws in workers], np.float32
-            ),
-            nthreads=np.asarray([ws.nthreads for ws in workers], np.int32),
-            idle=np.asarray([ws in idle_set for ws in workers], bool),
-            running=np.asarray([ws in s.running for ws in workers], bool),
+            occ=occ_kernel,
+            nthreads=nthreads_kernel,
+            idle=idle_kernel,
+            running=running_kernel,
         )
         # the kernel call (jit compile on first use — >1 s — plus the
         # dispatch+sync) runs on a daemon thread: a blocking jax call on
@@ -524,7 +663,7 @@ class WorkStealing:
         except RuntimeError:
             # no loop (sync tests): plan inline
             self._apply_device_plan(
-                ops_stealing.plan_steals(batch), tasks, workers
+                ops_stealing.plan_steals(batch), tasks, ws_of, alt_thief
             )
             return
         if self._device_executor is None:
@@ -539,14 +678,15 @@ class WorkStealing:
         def _done(f):
             try:
                 loop.call_soon_threadsafe(
-                    self._device_plan_landed, f, tasks, workers
+                    self._device_plan_landed, f, tasks, ws_of, alt_thief
                 )
             except RuntimeError:
                 self._device_plan_inflight = False  # loop closed
 
         fut.add_done_callback(_done)
 
-    def _device_plan_landed(self, fut, tasks: list, workers: list) -> None:
+    def _device_plan_landed(self, fut, tasks: list, ws_of: list,
+                            alt_thief: list) -> None:
         self._device_plan_inflight = False
         try:
             thief_of = fut.result()
@@ -556,29 +696,64 @@ class WorkStealing:
                     "device steal plan failed; python path continues"
                 )
             return
-        self._apply_device_plan(thief_of, tasks, workers)
+        self._apply_device_plan(thief_of, tasks, ws_of, alt_thief)
 
-    def _apply_device_plan(self, thief_of, tasks: list,
-                           workers: list) -> None:
+    def _steal_pays(self, ts: "TaskState", victim: "WorkerState",
+                    thief: "WorkerState") -> bool:
+        """The python balance criterion against LIVE state with the TRUE
+        per-thief comm cost (thief-resident dependencies subtracted) —
+        the device kernel priced every candidate at its best-case
+        cost, so each accepted move re-earns its place here."""
         s = self.state
-        for ts, ti in zip(tasks, thief_of):
+        compute = s.get_task_duration(ts)
+        return (
+            self._combined_occupancy(thief) / max(thief.nthreads, 1)
+            + s.get_comm_cost(ts, thief) + compute
+            <= self._combined_occupancy(victim) / max(victim.nthreads, 1)
+            - compute / 2
+        )
+
+    def _apply_device_plan(self, thief_of, tasks: list, ws_of: list,
+                           alt_thief: list | None = None) -> None:
+        s = self.state
+        if alt_thief is None:
+            alt_thief = [-1] * len(tasks)
+        for ts, ti, ai in zip(tasks, thief_of, alt_thief):
             if ti < 0:
                 continue
-            thief = workers[int(ti)]
+            thief = ws_of[int(ti)]
             victim = ts.processing_on
-            if victim is None or ts.key in self.in_flight:
+            if thief is None or victim is None or ts.key in self.in_flight:
                 continue
             if ts.homed:
                 # pinned home while the plan computed off-loop (shuffle
                 # registration): stealing it now would move its input
                 # partition off the very worker the pin protects
                 continue
-            if thief not in s.running:
-                continue
             valid = s.valid_workers(ts)
-            if valid is not None and thief not in valid \
-                    and not ts.loose_restrictions:
+
+            def eligible(w) -> bool:
+                if w is None or w is victim or w not in s.running:
+                    return False
+                return (
+                    valid is None or w in valid or ts.loose_restrictions
+                )
+
+            if not eligible(thief):
                 continue
+            if not self._steal_pays(ts, victim, thief):
+                # the rank-matched thief can't pay the true comm cost;
+                # the thief the lower-bound price was computed FOR (the
+                # idle holder of the most dependency bytes) may still
+                alt = (
+                    ws_of[int(ai)] if 0 <= int(ai) < len(ws_of) else None
+                )
+                if (
+                    alt is None or alt is thief or not eligible(alt)
+                    or not self._steal_pays(ts, victim, alt)
+                ):
+                    continue
+                thief = alt
             self.move_task_request(ts, victim, thief)
 
     def _combined_occupancy(self, ws: "WorkerState") -> float:

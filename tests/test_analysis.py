@@ -392,6 +392,81 @@ def test_swallowed_exceptions_allows_logged_or_narrow(tmp_path):
     )
 
 
+# ----------------------------------------------------------- mirror-parity
+
+
+def test_mirror_parity_fires_on_rogue_mutations(tmp_path):
+    src = """
+        def sneak_occupancy(ws, delta):
+            ws.occupancy += delta
+
+        def sneak_status(ws):
+            ws.status = "paused"
+
+        def sneak_replica(ws, ts):
+            ws.has_what[ts] = None
+            ws.nbytes += 10
+
+        def sneak_container(ws, ts):
+            ws.processing.pop(ts, None)
+            del ws.has_what[ts]
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/rogue.py": src}, "mirror-parity"
+    )
+    fields = sorted(
+        f.message.split("mirrored field `")[1].split("`")[0] for f in found
+    )
+    assert fields == [
+        "has_what", "has_what", "nbytes", "occupancy", "processing", "status",
+    ], found
+
+
+def test_mirror_parity_allows_helpers_scope_and_reads(tmp_path):
+    src = """
+        class WorkerState:
+            def __init__(self):
+                self.occupancy = 0.0
+                self.status = "running"
+
+            def clean(self):
+                ws = WorkerState()
+                ws.status = self.status
+                return ws
+
+        class SchedulerState:
+            def _adjust_occupancy(self, ws, delta):
+                ws.occupancy = max(0.0, ws.occupancy + delta)
+
+            def add_replica(self, ts, ws):
+                ws.nbytes += ts.nbytes
+                ws.has_what[ts] = None
+
+            def set_worker_status(self, ws, status):
+                ws.status = status
+
+        def reads_are_fine(ws):
+            return ws.occupancy / max(ws.nthreads, 1), ws.processing.get(None)
+
+        def other_objects_are_fine(ts, client):
+            ts.nbytes = 5          # TaskState, not a worker
+            client.status = "x"    # not a worker-state binding name
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src}, "mirror-parity"
+    )
+    # worker-side modules share field names but keep their own state:
+    # out of scope by construction
+    rogue = """
+        def worker_side(ws):
+            ws.occupancy = 1.0
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/state_machine.py": rogue},
+        "mirror-parity",
+    )
+
+
 # ------------------------------------------------------ pragma / baseline
 
 

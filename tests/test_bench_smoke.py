@@ -36,3 +36,14 @@ def test_bench_smoke_runs_and_reports():
     placement = out["configs"]["placement"]
     assert placement["n_tasks"] > 0
     assert placement["n_waves"] > 0
+    # mirror-fed steal + AMM cycle (scheduler/mirror.py): both kernels
+    # planned real work off the persistent fleet SoA with no from-
+    # scratch Python pack and no repeat full-fleet upload
+    mirror = out["configs"]["mirror"]
+    assert mirror["n_steals"] > 0
+    assert mirror["n_drops"] > 0
+    stats = mirror["mirror"]
+    assert stats["oracle_packs"] == 0
+    assert stats["oracle_failures"] == 0
+    assert stats["full_uploads"] <= 1
+    assert stats["rows_uploaded"] == 0
