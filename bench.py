@@ -921,6 +921,61 @@ def _smoke_mirror() -> dict:
     }
 
 
+async def _smoke_wire() -> dict:
+    """Wire microbench: loopback TCP echo round trips at 1 KB / 64 KB /
+    8 MB frames through the real comm stack, next to a join-copy
+    baseline writer over the same streams.  Raises if the zero-copy
+    send contract breaks (any payload copy recorded) or the pool never
+    gets a hit."""
+    import numpy as np
+
+    from distributed_tpu.comm.core import connect, listen
+    from distributed_tpu.protocol.buffers import WIRE
+    from distributed_tpu.protocol.serialize import Serialize
+
+    async def echo(comm):
+        try:
+            while True:
+                msg = await comm.read()
+                await comm.write({"op": "ack", "n": msg["n"]})
+        except Exception:
+            pass
+
+    listener = listen("tcp://127.0.0.1:0", echo)
+    await listener.start()
+    comm = await connect(listener.contact_address)
+    out: dict = {"mb_s": {}}
+    try:
+        before = WIRE.snapshot()
+        for label, size, reps in (
+            ("1KB", 1024, 60), ("64KB", 65536, 30), ("8MB", 8 * 2**20, 3)
+        ):
+            payload = np.random.default_rng(0).integers(
+                0, 256, size, dtype=np.uint8
+            )
+            await comm.write({"n": size, "data": Serialize(payload)})
+            await comm.read()  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                await comm.write({"n": size, "data": Serialize(payload)})
+                await comm.read()
+            wall = time.perf_counter() - t0
+            out["mb_s"][label] = round(size * reps / wall / 2**20, 1)
+        after = WIRE.snapshot()
+    finally:
+        await comm.close()
+        listener.stop()
+    out["payload_copies"] = after["payload_copies"] - before["payload_copies"]
+    out["pool_hits"] = after["pool_hits"] - before["pool_hits"]
+    out["wire_mb"] = round((after["bytes_sent"] - before["bytes_sent"]) / 2**20, 1)
+    assert out["payload_copies"] == 0, (
+        f"zero-copy send contract broken: {out['payload_copies']} payload "
+        f"copies on a tcp round trip"
+    )
+    assert out["pool_hits"] > 0, "receive pool recorded no reuse"
+    return out
+
+
 def run_smoke():
     """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
     line on stdout; raises (non-zero exit) on any failure."""
@@ -934,6 +989,7 @@ def run_smoke():
         "cluster": asyncio.run(_smoke_cluster()),
         "placement": _smoke_placement(),
         "mirror": _smoke_mirror(),
+        "wire": asyncio.run(_smoke_wire()),
     }
     print(
         json.dumps(

@@ -8,4 +8,5 @@ from distributed_tpu.analysis.rules import (  # noqa: F401
     monotonic_time,
     sans_io,
     swallowed,
+    wire_no_copy,
 )

@@ -7,9 +7,14 @@ Wire format per message (reference comm/tcp.py:372 shape):
     bytes   frame[0] ... frame[n_frames-1]
 
 Frames come from ``protocol.dumps`` (msgpack header + body + payload).
-Writes of large frames go straight to the transport without an extra copy;
-reads use ``readexactly``.  TLS wraps the same streams with an
-``ssl.SSLContext`` built by ``distributed_tpu.security.Security``.
+Zero-copy contract (docs/wire.md): the send side builds one scatter
+list — packed preamble plus the frames as-is — and hands memoryviews
+straight to the transport (small pieces coalesce into the preamble to
+bound syscalls; payload-sized frames are NEVER materialized).  The
+receive side reads the whole payload section into one pooled contiguous
+buffer and carves frames as read-only memoryview slices.  TLS wraps the
+same streams with an ``ssl.SSLContext`` built by
+``distributed_tpu.security.Security``.
 """
 
 from __future__ import annotations
@@ -24,10 +29,93 @@ from distributed_tpu.comm.addressing import parse_host_port, unparse_host_port
 from distributed_tpu.comm.core import Backend, Comm, Connector, Listener, register_backend
 from distributed_tpu.exceptions import CommClosedError, FatalCommClosedError
 from distributed_tpu.protocol import dumps, loads
+from distributed_tpu.protocol.buffers import WIRE, max_message_bytes, recv_pool
 
 _u64 = struct.Struct("<Q")
 
 MAX_FRAME_COUNT = 2**20  # sanity bound on header
+
+#: frames at or below this coalesce into the preamble write (one small
+#: gather copy instead of one syscall-sized write per tiny frame); above
+#: it a frame always rides the wire as its own zero-copy buffer
+COALESCE_MAX = 4096
+
+
+def scatter_frames(frames: list) -> tuple[list, int]:
+    """Build the scatter list for one message: packed preamble + frames.
+
+    Returns ``(buffers, total_bytes)``.  Small frames are gathered into
+    the preamble bytearray; large frames append as memoryviews with no
+    materialization (the ``dtpu_wire_payload_copies`` contract)."""
+    lengths = []
+    views = []
+    for f in frames:
+        if isinstance(f, (bytes, bytearray)):
+            lengths.append(len(f))
+            views.append(f)
+            continue
+        mv = f if isinstance(f, memoryview) else memoryview(f)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        lengths.append(mv.nbytes)
+        views.append(mv)
+    head = bytearray(_u64.pack(len(views)))
+    head += struct.pack(f"<{len(views)}Q", *lengths)
+    total = len(head) + sum(lengths)  # before coalescing grows `head`
+    out: list = [head]
+    # only ever extend scratch bytearrays WE created (head, or a fresh
+    # coalesce buffer): a large caller-owned bytearray frame sits in
+    # `out` too, and growing it would corrupt the caller's data
+    scratch: bytearray | None = head
+    for n, v in zip(lengths, views):
+        if n > COALESCE_MAX:
+            out.append(v)
+            scratch = None
+        elif scratch is not None:
+            scratch += v
+        else:
+            scratch = bytearray(v)
+            out.append(scratch)
+    return out, total
+
+
+async def readinto_exactly(reader: asyncio.StreamReader, view: memoryview) -> None:
+    """Fill ``view`` from the stream — the ``readinto`` asyncio's
+    StreamReader never grew.  Drains the reader's internal buffer
+    directly (one user-space copy, no per-read allocation); falls back
+    to chunked public-API reads if the internals ever move."""
+    n = view.nbytes
+    pos = 0
+    buf = getattr(reader, "_buffer", None)
+    if buf is None or not hasattr(reader, "_wait_for_data"):
+        while pos < n:  # pragma: no cover - exercised only off-CPython
+            chunk = await reader.read(n - pos)
+            if not chunk:
+                # graft-lint: allow[wire-no-copy] error-path partial for IncompleteReadError, connection is dead
+                raise asyncio.IncompleteReadError(bytes(view[:pos]), n)
+            view[pos : pos + len(chunk)] = chunk
+            pos += len(chunk)
+        return
+    while pos < n:
+        # set_exception with no pending waiter (peer RST between reads)
+        # leaves _buffer empty and _eof unset: without this check the
+        # _wait_for_data below would block forever.  readexactly makes
+        # the same raise-before-drain check.
+        exc = reader.exception()
+        if exc is not None:
+            raise exc
+        if not buf:
+            if reader.at_eof():
+                # graft-lint: allow[wire-no-copy] error-path partial for IncompleteReadError, connection is dead
+                raise asyncio.IncompleteReadError(bytes(view[:pos]), n)
+            await reader._wait_for_data("readinto_exactly")
+            continue
+        take = min(len(buf), n - pos)
+        with memoryview(buf) as src:  # released before the resize below
+            view[pos : pos + take] = src[:take]
+        del buf[:take]
+        reader._maybe_resume_transport()
+        pos += take
 
 
 def _set_tcp_options(sock: socket.socket) -> None:
@@ -49,47 +137,95 @@ class TCP(Comm):
         self._write_lock = asyncio.Lock()
 
     async def read(self) -> Any:
+        buf = view = ro = frames = None
         try:
-            head = await self._reader.readexactly(8)
-            (n_frames,) = _u64.unpack(head)
-            if n_frames > MAX_FRAME_COUNT:
-                raise CommClosedError(f"bad frame count {n_frames}")
-            lengths_raw = await self._reader.readexactly(8 * n_frames)
-            lengths = struct.unpack(f"<{n_frames}Q", lengths_raw)
-            frames = [await self._reader.readexactly(n) for n in lengths]
-        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError,
-                OSError) as e:
-            self.abort()
-            raise CommClosedError(f"read failed: {e!r}") from e
-        try:
-            return loads(frames, deserializers=self.deserialize)
-        except Exception:
-            self.abort()
-            raise
+            try:
+                head = await self._reader.readexactly(8)
+            except (asyncio.IncompleteReadError, ConnectionResetError,
+                    BrokenPipeError, OSError) as e:
+                self.abort()
+                raise CommClosedError(f"read failed: {e!r}") from e
+            # CancelledError above propagates WITHOUT abort: readexactly
+            # is all-or-nothing, so a cancelled idle wait leaves the
+            # stream at a message boundary and the comm reusable —
+            # teardown paths cancel pending reads on comms they then
+            # close in an orderly way
+            try:
+                (n_frames,) = _u64.unpack(head)
+                if n_frames > MAX_FRAME_COUNT:
+                    raise CommClosedError(f"bad frame count {n_frames}")
+                lengths_raw = await self._reader.readexactly(8 * n_frames)
+                lengths = struct.unpack(f"<{n_frames}Q", lengths_raw)
+                total = sum(lengths)
+                if total > max_message_bytes():
+                    raise CommClosedError(
+                        f"message of {total} bytes exceeds "
+                        f"comm.max-message-bytes ({max_message_bytes()})"
+                    )
+                # one pooled contiguous buffer for the whole payload
+                # section; frames are read-only zero-copy slices of it
+                buf = recv_pool().acquire(total)
+                view = memoryview(buf)[:total]
+                await readinto_exactly(self._reader, view)
+                WIRE.bytes_recv += total + 8 + 8 * n_frames
+                ro = view.toreadonly()
+                frames = []
+                off = 0
+                for n in lengths:
+                    frames.append(ro[off : off + n])
+                    off += n
+            except CommClosedError:
+                # our own guards (frame count / message size): the
+                # stream is desynced — abort, don't re-wrap
+                self.abort()
+                raise
+            except (asyncio.IncompleteReadError, ConnectionResetError,
+                    BrokenPipeError, OSError) as e:
+                self.abort()
+                raise CommClosedError(f"read failed: {e!r}") from e
+            except BaseException:
+                # anything else (MemoryError from the pool acquire,
+                # cancellation mid-message): the 8-byte count header is
+                # already consumed, so the stream is desynced — the
+                # next read would parse payload bytes as a frame count
+                self.abort()
+                raise
+            try:
+                return loads(frames, deserializers=self.deserialize)
+            except Exception:
+                self.abort()
+                raise
+        finally:
+            # drop our exports before offering the buffer back: if the
+            # message pinned zero-copy views (numpy frames, opaque
+            # Serialized payloads) the pool's export probe drops the
+            # buffer instead of ever reusing it under a live view
+            view = ro = frames = None
+            if buf is not None:
+                recv_pool().release(buf)
 
     async def write(self, msg: Any, on_error: str = "message") -> int:
         compression = self.handshake_options.get("compression", "auto")
         try:
             frames = dumps(msg, compression=compression)
-        except Exception:
+        except Exception as e:
             if on_error == "raise":
                 raise
             from distributed_tpu.utils import format_exception
 
             # graft-lint: allow[handler-parity] comm-layer sentinel surfaced to the reader, not a dispatched op
-            frames = dumps({"op": "protocol-error", "error": format_exception()})
-        lengths = [memoryview(f).nbytes for f in frames]
-        header = _u64.pack(len(frames)) + struct.pack(f"<{len(frames)}Q", *lengths)
+            frames = dumps({"op": "protocol-error", "error": format_exception(e)})
+        bufs, total = scatter_frames(frames)
         async with self._write_lock:
             try:
-                self._writer.write(header)
-                for f in frames:
-                    self._writer.write(bytes(f) if isinstance(f, memoryview) else f)
+                for b in bufs:
+                    self._writer.write(b)
                 await self._writer.drain()
             except (ConnectionResetError, BrokenPipeError, RuntimeError, OSError) as e:
                 self.abort()
                 raise CommClosedError(f"write failed: {e!r}") from e
-        return sum(lengths) + len(header)
+        WIRE.bytes_sent += total
+        return total
 
     async def close(self) -> None:
         if self._closed:

@@ -22,8 +22,14 @@ from typing import Any, Callable
 
 from distributed_tpu.comm.addressing import parse_host_port, unparse_host_port
 from distributed_tpu.comm.core import Backend, Comm, Connector, Listener, register_backend
+from distributed_tpu.comm.tcp import (
+    MAX_FRAME_COUNT,
+    readinto_exactly,
+    scatter_frames,
+)
 from distributed_tpu.exceptions import CommClosedError, FatalCommClosedError
 from distributed_tpu.protocol import dumps, loads
+from distributed_tpu.protocol.buffers import WIRE, max_message_bytes, recv_pool
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 _u64 = struct.Struct("<Q")
@@ -36,13 +42,34 @@ def _accept_key(key: str) -> str:
     ).decode()
 
 
-async def _read_ws_message(reader: asyncio.StreamReader,
-                           pong: Callable[[bytes], None] | None = None) -> bytes:
+async def _read_ws_message(
+    reader: asyncio.StreamReader,
+    pong: Callable[[bytes], None] | None = None,
+) -> tuple[memoryview, bytearray | None]:
     """Read one complete (possibly fragmented) binary message; answers
-    pings via ``pong`` (RFC 6455 §5.5.2 — proxies health-check with them)."""
-    parts: list[bytes] = []
+    pings via ``pong`` (RFC 6455 §5.5.2 — proxies health-check with them).
+
+    Returns ``(payload_view, pool_buf)``: a single-fragment message —
+    the common case, since our own sender only fragments above 8 MiB —
+    lands in one pooled buffer via ``readinto`` (unmasked in place);
+    ``pool_buf`` must be released by the caller after parsing.
+    Fragmented messages gather into one bytearray (``pool_buf`` None).
+    """
+    parts: bytearray | None = None
+    total = 0
+    limit = max_message_bytes()
     while True:
-        head = await reader.readexactly(2)
+        idle = parts is None and total == 0
+        try:
+            head = await reader.readexactly(2)
+        except asyncio.CancelledError as e:
+            if idle:
+                # readexactly is all-or-nothing: a cancelled wait before
+                # any data fragment leaves the stream at a frame
+                # boundary — the comm is still usable (teardown paths
+                # cancel pending reads on comms they then close cleanly)
+                e._dtpu_idle_cancel = True
+            raise
         fin = head[0] & 0x80
         opcode = head[0] & 0x0F
         masked = head[1] & 0x80
@@ -51,23 +78,54 @@ async def _read_ws_message(reader: asyncio.StreamReader,
             (length,) = struct.unpack(">H", await reader.readexactly(2))
         elif length == 127:
             (length,) = struct.unpack(">Q", await reader.readexactly(8))
-        mask = await reader.readexactly(4) if masked else None
-        payload = await reader.readexactly(length) if length else b""
-        if mask:
-            payload = bytes(
-                b ^ mask[i % 4] for i, b in enumerate(payload)
-            ) if length < 65536 else _unmask(payload, mask)
-        if opcode == 0x8:  # close
-            raise CommClosedError("ws close frame")
-        if opcode == 0x9:  # ping -> pong with the same payload
-            if pong is not None:
+        if opcode in (0x8, 0x9, 0xA):
+            if length > 125:
+                # RFC 6455 §5.5: control payloads cap at 125 bytes and
+                # never use extended lengths — a longer one is a
+                # corrupt/hostile header, not a big message, so it must
+                # not reach the readexactly allocation below
+                raise CommClosedError(
+                    f"ws control frame of {length} bytes"
+                )
+            mask = await reader.readexactly(4) if masked else None
+            payload = await reader.readexactly(length) if length else b""
+            if mask:
+                # graft-lint: allow[wire-no-copy] tiny control frame; RFC masking is a transform, not a payload copy
+                payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+            if opcode == 0x8:  # close
+                raise CommClosedError("ws close frame")
+            if opcode == 0x9 and pong is not None:  # ping -> echo payload
                 pong(payload)
             continue
-        if opcode == 0xA:  # pong
-            continue
-        parts.append(payload)
+        total += length
+        if total > limit:
+            raise CommClosedError(
+                f"ws message exceeds comm.max-message-bytes ({limit})"
+            )
+        mask = await reader.readexactly(4) if masked else None
+        if parts is None and fin:
+            # single-fragment fast path: pooled buffer + readinto,
+            # unmasked in place — no per-message allocation, no copy
+            buf = recv_pool().acquire(length)
+            view = memoryview(buf)[:length]
+            try:
+                if length:
+                    await readinto_exactly(reader, view)
+                    if mask:
+                        _unmask_into(view, mask)
+            except BaseException:
+                view = None  # release the export before the pool probe
+                recv_pool().release(buf)
+                raise
+            return view, buf
+        payload = await reader.readexactly(length) if length else b""
+        if mask:
+            payload = _unmask(payload, mask)
+        if parts is None:
+            parts = bytearray()
+        parts += payload
         if fin:
-            return b"".join(parts)
+            return memoryview(parts), None
 
 
 def _unmask(payload: bytes, mask: bytes) -> bytes:
@@ -78,42 +136,63 @@ def _unmask(payload: bytes, mask: bytes) -> bytes:
     return (data ^ m).tobytes()
 
 
+def _unmask_into(view: memoryview, mask: bytes) -> None:
+    """XOR-unmask ``view`` in place (no output allocation)."""
+    import numpy as np
+
+    data = np.frombuffer(view, np.uint8)
+    m = np.frombuffer((mask * ((len(data) + 3) // 4))[: len(data)], np.uint8)
+    data ^= m
+
+
 def _mask_payload(payload: bytes, mask: bytes) -> bytes:
-    if len(payload) < 65536:
-        return bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
-    return _unmask(payload, mask)  # xor is symmetric
+    # control frames only: payloads are RFC-capped at 125 bytes (the
+    # data plane masks in place via _unmask_into)
+    # graft-lint: allow[wire-no-copy] tiny control frame; RFC masking is a transform, not a payload copy
+    return bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
 
 
-def _ws_frames(payload: bytes, *, mask: bool) -> bytes:
-    """Encode one binary message, fragmenting at FRAGMENT_SIZE."""
-    out = bytearray()
-    offset = 0
-    first = True
-    total = len(payload)
-    while first or offset < total:
-        chunk = payload[offset:offset + FRAGMENT_SIZE]
-        offset += len(chunk)
-        fin = 0x80 if offset >= total else 0
-        opcode = 0x2 if first else 0x0
-        first = False
-        head = bytearray([fin | opcode])
-        n = len(chunk)
-        mask_bit = 0x80 if mask else 0
-        if n < 126:
-            head.append(mask_bit | n)
-        elif n < 65536:
-            head.append(mask_bit | 126)
-            head += struct.pack(">H", n)
-        else:
-            head.append(mask_bit | 127)
-            head += struct.pack(">Q", n)
-        if mask:
-            mkey = os.urandom(4)
-            head += mkey
-            chunk = _mask_payload(chunk, mkey)
-        out += head
-        out += chunk
-    return bytes(out)
+def _ws_head(flags: int, length: int, mkey: bytes | None) -> bytearray:
+    """One WebSocket frame header."""
+    head = bytearray([flags])
+    mask_bit = 0x80 if mkey is not None else 0
+    if length < 126:
+        head.append(mask_bit | length)
+    elif length < 65536:
+        head.append(mask_bit | 126)
+        head += struct.pack(">H", length)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack(">Q", length)
+    if mkey is not None:
+        head += mkey
+    return head
+
+
+class _PieceCursor:
+    """Walk a scatter list as one logical byte string, zero-copy."""
+
+    def __init__(self, bufs: list):
+        self._bufs = [
+            b if isinstance(b, memoryview) else memoryview(b) for b in bufs
+        ]
+        self._i = 0
+        self._off = 0
+
+    def take(self, n: int) -> list[memoryview]:
+        out: list[memoryview] = []
+        while n:
+            mv = self._bufs[self._i]
+            avail = mv.nbytes - self._off
+            if not avail:
+                self._i += 1
+                self._off = 0
+                continue
+            t = min(avail, n)
+            out.append(mv[self._off : self._off + t])
+            self._off += t
+            n -= t
+        return out
 
 
 class WS(Comm):
@@ -142,49 +221,108 @@ class WS(Comm):
                 payload = _mask_payload(payload, mkey)
             else:
                 head.append(n)
+            # graft-lint: allow[wire-no-copy] pong control frame is <=125 bytes by RFC
             self._writer.write(bytes(head) + payload)
         except Exception:
             pass
 
     async def read(self) -> Any:
+        payload = pool_buf = ro = frames = None
         try:
-            payload = await _read_ws_message(self._reader, pong=self._send_pong)
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
-                CommClosedError) as e:
-            self.abort()
-            raise CommClosedError(f"ws read failed: {e!r}") from e
-        try:
-            (n_frames,) = _u64.unpack(payload[:8])
-            lengths = struct.unpack_from(f"<{n_frames}Q", payload, 8)
-            frames = []
-            offset = 8 + 8 * n_frames
-            for n in lengths:
-                frames.append(payload[offset:offset + n])
-                offset += n
-            return loads(frames, deserializers=self.deserialize)
-        except Exception:
-            self.abort()
-            raise
+            try:
+                payload, pool_buf = await _read_ws_message(
+                    self._reader, pong=self._send_pong
+                )
+            except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
+                    CommClosedError) as e:
+                self.abort()
+                raise CommClosedError(f"ws read failed: {e!r}") from e
+            except BaseException as e:
+                if getattr(e, "_dtpu_idle_cancel", False):
+                    raise  # cancelled idle wait — still at a frame boundary
+                # anything else (MemoryError from the pool acquire,
+                # cancellation mid-frame): ws frame headers are already
+                # consumed, so the stream is desynced
+                self.abort()
+                raise
+            try:
+                (n_frames,) = _u64.unpack(payload[:8])
+                if n_frames > MAX_FRAME_COUNT:
+                    raise CommClosedError(f"bad frame count {n_frames}")
+                lengths = struct.unpack_from(f"<{n_frames}Q", payload, 8)
+                WIRE.bytes_recv += payload.nbytes
+                ro = payload.toreadonly()
+                frames = []
+                offset = 8 + 8 * n_frames
+                for n in lengths:
+                    frames.append(ro[offset : offset + n])
+                    offset += n
+                return loads(frames, deserializers=self.deserialize)
+            except struct.error as e:
+                # corrupt preamble (short payload, bogus counts): same
+                # orderly-disconnect surface as the tcp guards
+                self.abort()
+                raise CommClosedError(f"ws corrupt preamble: {e!r}") from e
+            except Exception:
+                self.abort()
+                raise
+        finally:
+            # drop our exports, then offer the pooled buffer back (the
+            # pool's probe keeps it out of circulation while any
+            # deserialized value still views it — docs/wire.md)
+            payload = ro = frames = None
+            if pool_buf is not None:
+                recv_pool().release(pool_buf)
 
     async def write(self, msg: Any, on_error: str = "message") -> int:
         compression = self.handshake_options.get("compression", "auto")
         frames = dumps(msg, compression=compression)
-        lengths = [memoryview(f).nbytes for f in frames]
-        payload = (
-            _u64.pack(len(frames))
-            + struct.pack(f"<{len(frames)}Q", *lengths)
-            + b"".join(bytes(f) for f in frames)
-        )
-        encoded = _ws_frames(payload, mask=self._is_client)
+        bufs, total = scatter_frames(frames)
+        cursor = _PieceCursor(bufs)
+        n_frag = max(1, -(-total // FRAGMENT_SIZE))
+        wire_bytes = 0
         async with self._write_lock:
             try:
-                self._writer.write(encoded)
+                sent = 0
+                for i in range(n_frag):
+                    frag_len = min(FRAGMENT_SIZE, total - sent)
+                    flags = (0x80 if i == n_frag - 1 else 0) | (
+                        0x2 if i == 0 else 0x0
+                    )
+                    pieces = cursor.take(frag_len)
+                    if self._is_client:
+                        # RFC 6455 client frames mask every byte: the
+                        # one place the ws data plane must materialize
+                        mkey = os.urandom(4)
+                        WIRE.payload_copies += 1
+                        import numpy as np
+
+                        # np.empty, not bytearray: no zero-fill memset
+                        # of up to 8 MiB that the gather loop below
+                        # fully overwrites anyway
+                        body = memoryview(np.empty(frag_len, np.uint8))
+                        pos = 0
+                        for p in pieces:
+                            body[pos : pos + p.nbytes] = p
+                            pos += p.nbytes
+                        _unmask_into(body, mkey)  # xor is symmetric
+                        head = _ws_head(flags, frag_len, mkey)
+                        self._writer.write(head)
+                        self._writer.write(body)
+                    else:
+                        head = _ws_head(flags, frag_len, None)
+                        self._writer.write(head)
+                        for p in pieces:
+                            self._writer.write(p)
+                    wire_bytes += len(head) + frag_len
+                    sent += frag_len
                 await self._writer.drain()
             except (ConnectionResetError, BrokenPipeError, RuntimeError,
                     OSError) as e:
                 self.abort()
                 raise CommClosedError(f"ws write failed: {e!r}") from e
-        return len(encoded)
+        WIRE.bytes_sent += wire_bytes
+        return wire_bytes
 
     async def close(self) -> None:
         if self._closed:

@@ -208,6 +208,12 @@ defaults: dict[str, Any] = {
         "compression": False,            # yaml: compression false by default
         "shard": "64MiB",
         "offload": "10MiB",
+        # hard cap on one wire message (frame-lengths sum): a corrupt or
+        # hostile header must not trigger an arbitrary-size allocation
+        "max-message-bytes": "2GiB",
+        # total bytes the zero-copy receive pool may keep cached
+        # (protocol/buffers.py BufferPool; docs/wire.md)
+        "receive-pool-bytes": "64MiB",
         "default-scheme": "tcp",
         "socket-backlog": 2048,
         "timeouts": {"connect": "30s", "tcp": "30s"},

@@ -146,6 +146,42 @@ def prom_line(name: str, value: float, labels: dict | None = None,
     return "\n".join(out)
 
 
+def wire_metric_lines() -> list[str]:
+    """``dtpu_wire_*`` exposition shared by every server role: the
+    zero-copy data plane counters (protocol/buffers.py).  A production
+    regression — payload copies creeping back onto the send path, pool
+    hit rate collapsing, compression volume vanishing — is observable
+    here, not only in tests."""
+    from distributed_tpu.protocol.buffers import WIRE, recv_pool
+
+    lines = []
+    for name, help_ in (
+        ("bytes_sent", "Bytes written to comm transports"),
+        ("bytes_recv", "Bytes read from comm transports"),
+        ("payload_copies", "Payload-frame materializations on the wire path"),
+        ("pool_hits", "Receive-buffer pool hits"),
+        ("pool_misses", "Receive-buffer pool misses (fresh allocations)"),
+        ("pool_drops", "Pooled buffers dropped (live views or budget)"),
+        ("compress_bytes_in", "Uncompressed bytes entering frame compression"),
+        ("compress_bytes_out", "Compressed bytes leaving frame compression"),
+        ("decompress_bytes_in", "Compressed bytes entering decompression"),
+    ):
+        lines.append(
+            prom_line(
+                f"dtpu_wire_{name}_total", getattr(WIRE, name),
+                help_=help_, type_="counter",
+            )
+        )
+    lines.append(
+        prom_line(
+            "dtpu_wire_pool_bytes", recv_pool().pooled_bytes,
+            help_="Bytes currently cached in the receive-buffer pool",
+            type_="gauge",
+        )
+    )
+    return lines
+
+
 def scheduler_metrics(scheduler: Any) -> bytes:
     """Prometheus exposition for the scheduler
     (reference http/scheduler/prometheus/core.py)."""
@@ -213,6 +249,7 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                     type_="counter",
                 )
             )
+    lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()
 
 
@@ -227,6 +264,10 @@ def worker_metrics(worker: Any) -> bytes:
         prom_line("dtpu_worker_nbytes", st.nbytes_in_memory,
                   help_="Managed memory bytes", type_="gauge"),
         prom_line("dtpu_worker_transfers_incoming", st.transfer_incoming_count),
+        prom_line("dtpu_worker_get_data_wire_bytes_total",
+                  worker.get_data_wire_bytes,
+                  help_="Wire bytes served to peers via get_data",
+                  type_="counter"),
     ]
     data = worker.data
     if hasattr(data, "spilled_count"):
@@ -235,4 +276,5 @@ def worker_metrics(worker: Any) -> bytes:
                       type_="counter")
         )
         lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
+    lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -26,6 +26,7 @@ import msgpack
 
 from distributed_tpu import config
 from distributed_tpu.protocol import pickle as _pickle
+from distributed_tpu.protocol.buffers import WIRE
 from distributed_tpu.protocol.compression import (
     decompress_frame,
     get_default_compression,
@@ -37,6 +38,7 @@ from distributed_tpu.protocol.serialize import (
     Serialized,
     ToPickle,
     deserialize,
+    pickle_oob_frames,
     serialize,
 )
 
@@ -70,6 +72,7 @@ def _msgpack_default(obj: Any):
     if isinstance(obj, tuple):  # pragma: no cover - tuples already converted
         return list(obj)
     if isinstance(obj, bytearray):
+        # graft-lint: allow[wire-no-copy] msgpack envelope value, not a payload frame
         return bytes(obj)
     raise TypeError(f"cannot msgpack {type(obj)!r}")
 
@@ -103,7 +106,7 @@ def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryv
             buffers: list = []
             data = _pickle.dumps(leaf.data, buffer_callback=buffers.append)
             head = {"serializer": "pickle", "num-buffers": len(buffers)}
-            frames = [data] + list(buffers)
+            frames = [data] + pickle_oob_frames(buffers)
         # COPY before annotating: a Serialized leaf hands back its OWN
         # header dict, and one object can appear at many paths (e.g. a
         # single erred exception blamed on 16 dependents in one report
@@ -117,10 +120,21 @@ def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryv
         split_sizes: list[int] = []
         uncompressed = 0
         for f in frames:
-            mv = memoryview(f).cast("B") if not isinstance(f, bytes) else f
-            n = memoryview(mv).nbytes
+            if isinstance(f, bytes):
+                mv = f
+                n = len(f)
+            else:
+                mv = memoryview(f)
+                if mv.format != "B" or mv.ndim != 1:
+                    mv = mv.cast("B")
+                n = mv.nbytes
             uncompressed += n
             if n > shard:
+                # shard boundaries live in the header ("splits"); the
+                # parts are zero-copy views — bytes frames too (slicing
+                # bytes directly would materialize a copy per shard)
+                if isinstance(mv, bytes):
+                    mv = memoryview(mv)
                 parts = [mv[i : i + shard] for i in range(0, n, shard)]
             else:
                 parts = [mv]
@@ -148,6 +162,61 @@ def dumps(msg: Any, *, compression: str | None = "auto") -> list[bytes | memoryv
     body = msgpack.packb(skeleton, default=_msgpack_default, strict_types=False)
     head_frame = msgpack.packb(header, default=_msgpack_default)
     return [head_frame, body] + payload_frames
+
+
+def _buffer_address(view: memoryview) -> int:
+    import numpy as np
+
+    return np.frombuffer(view, np.uint8).__array_interface__["data"][0]
+
+
+def _merge_parts(parts: list) -> Any:
+    """Reassemble one sharded frame from its split parts.
+
+    Fast path: when every part is an uncompressed memoryview slice of
+    the SAME backing buffer and the slices are adjacent — the common
+    case, because the receive side reads the whole message into one
+    contiguous buffer and dumps shards frames in order — the merge is a
+    single zero-copy slice of that buffer.  Otherwise (some shards were
+    compressed, or arrived in separate buffers) the parts gather into
+    ONE preallocated bytearray: one copy total, never bytes()-per-part.
+    """
+    base = parts[0].obj if isinstance(parts[0], memoryview) else None
+    if base is not None and all(
+        isinstance(p, memoryview)
+        and p.obj is base
+        and p.contiguous
+        and p.format == "B"
+        and p.ndim == 1
+        and p.nbytes
+        for p in parts
+    ):
+        try:
+            start = _buffer_address(parts[0]) - _buffer_address(
+                memoryview(base)
+            )
+            expect = _buffer_address(parts[0])
+            adjacent = True
+            for p in parts:
+                if _buffer_address(p) != expect:
+                    adjacent = False
+                    break
+                expect += p.nbytes
+            if adjacent:
+                total = sum(p.nbytes for p in parts)
+                merged = memoryview(base)[start : start + total]
+                if merged.nbytes == total:
+                    return merged.toreadonly()
+        except (TypeError, ValueError, BufferError):
+            pass  # exotic exporter: fall through to the gather
+    WIRE.payload_copies += 1
+    out = bytearray(sum(memoryview(p).nbytes for p in parts))
+    pos = 0
+    for p in parts:
+        n = memoryview(p).nbytes
+        out[pos : pos + n] = p
+        pos += n
+    return memoryview(out).toreadonly()
 
 
 def _plant(obj: Any, values: dict[tuple, Any]) -> Any:
@@ -189,7 +258,7 @@ def loads(frames: list, *, deserializers: bool = True) -> Any:
             if len(parts) == 1:
                 leaf_frames.append(parts[0])
             else:
-                leaf_frames.append(b"".join(bytes(p) for p in parts))
+                leaf_frames.append(_merge_parts(parts))
         path = tuple(sub["path"])
         sub2 = {k: v for k, v in sub.items() if k not in ("path", "frame-start", "splits")}
         if deserializers:

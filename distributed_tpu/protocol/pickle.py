@@ -20,17 +20,26 @@ HIGHEST_PROTOCOL = pickle.HIGHEST_PROTOCOL
 
 
 def dumps(x: Any, *, buffer_callback=None) -> bytes:
-    """Pickle with the best available serializer for ``x``."""
+    """Pickle with the best available serializer for ``x``.
+
+    Buffers collect locally and reach ``buffer_callback`` only after a
+    serializer SUCCEEDS: plain pickle may emit out-of-band buffers for
+    early objects and then raise on a later unpicklable one — handing
+    those stale buffers to the caller would misalign them against the
+    cloudpickle stream's own full set and silently shift every
+    out-of-band payload at load time."""
     buffers: list = []
-    cb = buffers.append if buffer_callback is None else buffer_callback
     try:
-        return pickle.dumps(x, protocol=5, buffer_callback=cb)
+        data = pickle.dumps(x, protocol=5, buffer_callback=buffers.append)
     except Exception:
-        if buffer_callback is None:
-            buffers.clear()
+        buffers.clear()
         if cloudpickle is None:
             raise
-        return cloudpickle.dumps(x, protocol=5, buffer_callback=cb)
+        data = cloudpickle.dumps(x, protocol=5, buffer_callback=buffers.append)
+    if buffer_callback is not None:
+        for b in buffers:
+            buffer_callback(b)
+    return data
 
 
 def loads(data: bytes, *, buffers=()) -> Any:

@@ -115,6 +115,24 @@ def wrap_opaque(obj: Any) -> Any:
     return ToPickle(obj)
 
 
+def compact_frames(obj: Any) -> Any:
+    """Copy view-backed frames of a LONG-LIVED opaque wrapper into owned
+    bytes (docs/wire.md ownership rule: holders that outlive the message
+    must copy).  A ``Serialized`` run_spec on a deserialize=False server
+    is a small slice of the message's whole pooled receive buffer; kept
+    as a view for the task's lifetime it would pin that entire buffer —
+    a ~100-byte spec holding megabytes.  One exact-size copy at store
+    time restores the pre-zero-copy memory profile for stores while the
+    forwarding path stays copy-free.  Pass-through for non-wrappers."""
+    if isinstance(obj, (Serialized, Pickled)):
+        obj.frames = [
+            # graft-lint: allow[wire-no-copy] long-lived store: the copy releases the pinned receive buffer
+            bytes(f) if isinstance(f, memoryview) else f
+            for f in obj.frames
+        ]
+    return obj
+
+
 def payload_nbytes(obj: Any) -> int:
     """Size estimate for accounting without deserializing: the dumps-time
     uncompressed size when the header recorded one (protocol/core.py),
@@ -159,17 +177,34 @@ def register_serialization_family(name: str, dumps: Callable, loads: Callable) -
     families[name] = (dumps, loads)
 
 
+def pickle_oob_frames(buffers: list) -> list:
+    """Protocol-5 out-of-band buffers as wire frames, zero-copy: a
+    ``PickleBuffer``'s ``raw()`` view shares the producer's memory (and
+    keeps it alive).  Non-contiguous exporters — which ``raw()`` refuses
+    — are materialized once."""
+    frames = []
+    for b in buffers:
+        if isinstance(b, (bytes, memoryview)):
+            frames.append(b)
+            continue
+        try:
+            frames.append(b.raw())
+        except (AttributeError, BufferError):
+            # graft-lint: allow[wire-no-copy] non-contiguous pickle buffer: a flat copy is the only way onto the wire
+            frames.append(bytes(b))
+    return frames
+
+
 def _pickle_dumps(x: Any) -> tuple[dict, list]:
     buffers: list = []
     data = _pickle.dumps(x, buffer_callback=buffers.append)
-    frames = [data] + [bytes(b) if not isinstance(b, (bytes, memoryview)) else b
-                       for b in buffers]
+    frames = [data] + pickle_oob_frames(buffers)
     return {"serializer": "pickle", "num-buffers": len(buffers)}, frames
 
 
 def _pickle_loads(header: dict, frames: list) -> Any:
-    return _pickle.loads(bytes(frames[0]) if not isinstance(frames[0], bytes) else frames[0],
-                         buffers=frames[1:])
+    # pickle.loads takes any bytes-like pickle stream; frames stay views
+    return _pickle.loads(frames[0], buffers=frames[1:])
 
 
 register_serialization_family("pickle", _pickle_dumps, _pickle_loads)
@@ -310,6 +345,7 @@ def _error_dumps(x: Any) -> tuple[dict, list]:
 
 
 def _error_loads(header: dict, frames: list) -> Any:
+    # graft-lint: allow[wire-no-copy] error-family repr, capped at 10 kB upstream
     raise TypeError(f"Could not deserialize object: {bytes(frames[0])!r}")
 
 

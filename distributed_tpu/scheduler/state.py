@@ -43,7 +43,7 @@ from distributed_tpu.exceptions import (
     TransitionCounterMaxExceeded,
 )
 from distributed_tpu.graph.spec import TaskSpec
-from distributed_tpu.protocol.serialize import wrap_opaque
+from distributed_tpu.protocol.serialize import compact_frames, wrap_opaque
 from distributed_tpu.utils import HeapSet, key_split, time
 
 logger = logging.getLogger("distributed_tpu.scheduler")
@@ -1062,10 +1062,12 @@ class SchedulerState:
             return recommendations, client_msgs, {}
 
         if exception is not None:
-            ts.exception = exception
+            # erred state can outlive the wire message indefinitely:
+            # compact so the stored frames stop pinning the receive buffer
+            ts.exception = compact_frames(exception)
             ts.exception_text = exception_text
         if traceback is not None:
-            ts.traceback = traceback
+            ts.traceback = compact_frames(traceback)
             ts.traceback_text = traceback_text
         if cause is not None:
             ts.exception_blame = self.tasks.get(cause)
@@ -2421,10 +2423,13 @@ class SchedulerState:
             ts = self.tasks.get(key)
             fresh = False
             if ts is None:
-                ts = self.new_task(key, spec, "released")
+                # run_spec lives as long as the task: compact opaque
+                # specs so a ~100-byte Serialized slice doesn't pin the
+                # whole pooled receive buffer it arrived in (docs/wire.md)
+                ts = self.new_task(key, compact_frames(spec), "released")
                 fresh = spec is not None
             elif ts.run_spec is None and spec is not None:
-                ts.run_spec = spec
+                ts.run_spec = compact_frames(spec)
                 fresh = True
             # only NEWLY runnable tasks attribute their group here: a
             # resubmission of known keys must not clone old groups into

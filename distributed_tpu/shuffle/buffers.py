@@ -232,8 +232,12 @@ class MemoryShardsBuffer(ShardsBuffer):
 
 class DiskShardsBuffer(ShardsBuffer):
     """Append-only spill file per output partition (reference
-    shuffle/_disk.py).  Shards are pickled length-prefixed frames; file
-    IO runs in a thread so the event loop never blocks on disk."""
+    shuffle/_disk.py).  Each record is a protocol-5 pickle with its
+    out-of-band buffers stored as separate length-prefixed frames —
+    ``[u64 n_frames][u64 len]*n [frames...]`` — so array payloads are
+    written without being re-copied into the pickle stream and read
+    back as zero-copy views of one file blob.  File IO runs in a thread
+    so the event loop never blocks on disk."""
 
     def __init__(self, directory: str,
                  limiter: ResourceLimiter | None = None):
@@ -246,19 +250,28 @@ class DiskShardsBuffer(ShardsBuffer):
         return os.path.join(self.directory, f"{id}.shards")
 
     async def _process(self, id: Any, shards: list) -> None:
-        payload = b"".join(
-            struct.pack("<Q", len(frame)) + frame
-            for frame in (pickle.dumps(s, protocol=5) for s in shards)
-        )
+        from distributed_tpu.protocol.serialize import pickle_oob_frames
+
+        pieces: list = []
+        for s in shards:
+            buffers: list = []
+            data = pickle.dumps(s, protocol=5, buffer_callback=buffers.append)
+            frames = [data] + pickle_oob_frames(buffers)
+            lengths = [memoryview(f).nbytes for f in frames]
+            pieces.append(
+                struct.pack(f"<{1 + len(frames)}Q", len(frames), *lengths)
+            )
+            pieces.extend(frames)
         async with self._locks[id]:
             await asyncio.get_running_loop().run_in_executor(
-                None, self._append, self._path(id), payload
+                None, self._append, self._path(id), pieces
             )
 
     @staticmethod
-    def _append(path: str, payload: bytes) -> None:
+    def _append(path: str, pieces: list) -> None:
         with open(path, "ab") as f:
-            f.write(payload)
+            for p in pieces:
+                f.write(p)
 
     async def read(self, id: Any) -> list:
         """All shards spilled for this partition (flushes first)."""
@@ -273,14 +286,28 @@ class DiskShardsBuffer(ShardsBuffer):
         if not os.path.exists(path):
             return []
         out = []
+        # read into a mutable blob: shards reconstruct as writable views
+        # (the in-band pickle path returned writable copies — a consumer
+        # mutating a shard in place must not fail only when it spilled)
+        size = os.path.getsize(path)
+        data = bytearray(size)
         with open(path, "rb") as f:
-            data = f.read()
+            n = f.readinto(data)
+        if n != size:
+            del data[n:]
+        mv = memoryview(data)
         off = 0
         while off < len(data):
-            (n,) = struct.unpack_from("<Q", data, off)
+            (n_frames,) = struct.unpack_from("<Q", data, off)
             off += 8
-            out.append(pickle.loads(data[off:off + n]))
-            off += n
+            lengths = struct.unpack_from(f"<{n_frames}Q", data, off)
+            off += 8 * n_frames
+            frames = []
+            for n in lengths:
+                frames.append(mv[off : off + n])
+                off += n
+            # buffers deserialize as views of the one file blob
+            out.append(pickle.loads(frames[0], buffers=frames[1:]))
         return out
 
     async def close(self) -> None:

@@ -174,6 +174,7 @@ class Worker(Server):
         # placement quality shows up directly as fewer get_data serves)
         self.get_data_requests = 0
         self.get_data_keys_served = 0
+        self.get_data_wire_bytes = 0
         # concurrent get_data serves (reply writes included); beyond the
         # limit peers get {"status": "busy"} (reference
         # connections.outgoing, worker.py:~1740)
@@ -547,7 +548,10 @@ class Worker(Server):
                 float(sum(nbytes.values())),
             )
             if reply:
-                await comm.write(
+                # comm.write returns true wire bytes (post-compression,
+                # incl. framing): the gap between this and the nbytes
+                # sum above is the zero-copy data plane's effectiveness
+                self.get_data_wire_bytes += await comm.write(
                     {"status": "OK", "data": data, "nbytes": nbytes}
                 )
             return Status.dont_reply

@@ -467,6 +467,74 @@ def test_mirror_parity_allows_helpers_scope_and_reads(tmp_path):
     )
 
 
+# ------------------------------------------------------- wire-no-copy
+
+
+def test_wire_no_copy_fires_on_materialization(tmp_path):
+    src = """
+        def write_frames(writer, frames):
+            for f in frames:
+                writer.write(bytes(f))
+
+        def reassemble(parts):
+            return b"".join(bytes(p) for p in parts)
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/comm/rogue.py": src}, "wire-no-copy"
+    )
+    # bytes(f), b"".join(...), bytes(p) inside the genexp
+    assert len(found) == 3, found
+    assert any("join" in f.message for f in found)
+
+
+def test_wire_no_copy_allows_sanctioned_idioms(tmp_path):
+    src = """
+        import struct
+
+        def scatter(writer, frames):
+            for f in frames:
+                writer.write(f)            # pass-through, no copy
+
+        def gather(parts):
+            out = bytearray(sum(len(p) for p in parts))
+            pos = 0
+            for p in parts:
+                out[pos:pos + len(p)] = p  # one preallocated gather
+                pos += len(p)
+            return memoryview(out).toreadonly()
+
+        def construction_not_conversion(n):
+            return bytes(16), struct.pack("<Q", n), bytes()
+
+        def outside_scope_is_fine():
+            pass
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/protocol/clean.py": src}, "wire-no-copy"
+    )
+    # scheduler code may materialize freely: out of scope by construction
+    rogue = """
+        def report(frames):
+            return b"".join(bytes(f) for f in frames)
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/report.py": rogue},
+        "wire-no-copy",
+    )
+
+
+def test_wire_no_copy_pragma_suppresses(tmp_path):
+    src = """
+        def error_repr(frames):
+            # graft-lint: allow[wire-no-copy] error-path repr only
+            return repr(bytes(frames[0]))
+    """
+    root = make_repo(tmp_path, {"distributed_tpu/comm/err.py": src})
+    result = run_lint(root, rule_names=["wire-no-copy"])
+    assert not result.findings
+    assert result.suppressed == 1
+
+
 # ------------------------------------------------------ pragma / baseline
 
 

@@ -47,3 +47,11 @@ def test_bench_smoke_runs_and_reports():
     assert stats["oracle_failures"] == 0
     assert stats["full_uploads"] <= 1
     assert stats["rows_uploaded"] == 0
+    # zero-copy wire contract (protocol/buffers.py, docs/wire.md): tcp
+    # round trips at 1 KB / 64 KB / 8 MB recorded NO payload copy on
+    # the send path and the receive pool saw reuse
+    wire = out["configs"]["wire"]
+    assert wire["payload_copies"] == 0
+    assert wire["pool_hits"] > 0
+    for label in ("1KB", "64KB", "8MB"):
+        assert wire["mb_s"][label] > 0

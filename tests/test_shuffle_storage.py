@@ -81,6 +81,23 @@ async def test_disk_buffer_spills_and_reads_back(tmp_path):
 
 
 @gen_test()
+async def test_disk_shards_read_back_writable(tmp_path):
+    """Spilled shards must reconstruct as writable arrays: the in-band
+    pickle path returned writable copies, and a consumer mutating a
+    shard in place must not fail only when its partition spilled."""
+    buf = DiskShardsBuffer(str(tmp_path / "spill"))
+    payload = np.arange(16)
+    await buf.write({0: [(0, payload)]})
+    await buf.flush()
+    (got,) = await buf.read(0)
+    arr = got[1]
+    assert arr.flags.writeable
+    arr += 1
+    np.testing.assert_array_equal(arr, payload + 1)
+    await buf.close()
+
+
+@gen_test()
 async def test_disk_buffer_backpressure_still_completes(tmp_path):
     # limiter far smaller than the data: writers must block-and-drain,
     # never fail — this is the "shuffle more than memory" contract
