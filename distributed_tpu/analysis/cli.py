@@ -35,6 +35,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rule", action="append", dest="rules", default=None,
                         metavar="NAME", help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--dump-model", type=Path, default=None, metavar="DIR",
+                        help="extract the task state machines and write "
+                             "<name>.json/<name>.dot per machine to DIR "
+                             "(the docs/state_machine/ artifacts)")
     parser.add_argument("--verbose", "-v", action="store_true")
     args = parser.parse_args(argv)
 
@@ -51,6 +55,35 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     config = LintConfig.load(root)
+
+    if args.dump_model is not None:
+        if args.rules:
+            parser.error(
+                "--dump-model is a pure extraction mode and runs no rules; "
+                "invoke the lint (with --rule, if wanted) separately"
+            )
+        from distributed_tpu.analysis.core import LintContext
+        from distributed_tpu.analysis.model import (
+            extract_machines,
+            machine_to_dot,
+            machine_to_json,
+        )
+
+        ctx = LintContext(root, config)
+        machines = extract_machines(ctx.all_modules)
+        args.dump_model.mkdir(parents=True, exist_ok=True)
+        for machine in machines:
+            (args.dump_model / f"{machine.name}.json").write_text(
+                machine_to_json(machine)
+            )
+            (args.dump_model / f"{machine.name}.dot").write_text(
+                machine_to_dot(machine)
+            )
+            print(f"# wrote {machine.name}.json/.dot "
+                  f"({len(machine.transitions)} transitions, "
+                  f"{len(machine.emissions)} emissions)", file=sys.stderr)
+        return 0
+
     baseline = Baseline.load(root / config.baseline_file)
     result = run_lint(
         root, config=config, baseline=baseline, rule_names=args.rules,

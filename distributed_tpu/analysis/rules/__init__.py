@@ -1,12 +1,15 @@
 """Rule modules self-register on import; import them all here."""
 
 from distributed_tpu.analysis.rules import (  # noqa: F401
+    await_atomicity,
     blocking_async,
+    config_keys,
     handler_parity,
     jit_purity,
     mirror_parity,
     monotonic_time,
     sans_io,
+    state_machine,
     swallowed,
     wire_no_copy,
 )

@@ -22,7 +22,14 @@ This whole-program rule:
 3. walks every rpc-proxy call ``<...rpc(...)>.op(key=...)`` and every
    literal message ``{"op": "name", key: ...}`` in the package and flags
    ops with no handler anywhere, and keyword sets that **no** registered
-   handler for that op accepts.
+   handler for that op accepts;
+4. holds the batch dispatch plane to its scalar oracle: every op
+   registered in ``stream_batch_handlers`` (the same-op folds in
+   rpc/core.py handle_stream) must also have a scalar stream handler,
+   every payload key the batch handler consumes (``m.pop("k")`` /
+   ``m.get("k")``) must be accepted by that scalar handler, and every
+   explicit scalar payload param must be consumed (or carried through a
+   residual dict) by the batch arm — so the two planes cannot drift.
 """
 
 from __future__ import annotations
@@ -36,6 +43,9 @@ from distributed_tpu.analysis.core import Finding, LintContext, Rule, register
 
 #: protocol-level keys stripped by the server before dispatch
 _PROTOCOL_KEYS = {"op", "reply", "serializers"}
+#: stream-context keys injected by handle_stream's ``extra`` (sender
+#: address), present on both planes without appearing in messages
+_STREAM_EXTRA_KEYS = {"worker", "client"}
 #: attrs that exist on the rpc proxy objects themselves — not ops
 _PROXY_ATTRS = {"send_recv", "close_rpc", "live_comm", "address", "comms",
                 "pool", "status", "timeout"}
@@ -56,12 +66,15 @@ class HandlerInfo:
 
 
 def _table_name(target: ast.AST) -> str | None:
-    """'handlers'/'stream_handlers' if target is such a table reference."""
+    """'handlers'/'stream_handlers'/'stream_batch_handlers' if target is
+    such a table reference."""
     name = astutils.dotted(target)
     if name is None:
         return None
     tail = name.rsplit(".", 1)[-1]
-    return tail if tail in ("handlers", "stream_handlers") else None
+    if tail in ("handlers", "stream_handlers", "stream_batch_handlers"):
+        return tail
+    return None
 
 
 def _resolve_params(
@@ -90,6 +103,55 @@ def _resolve_params(
     if ordered and ordered[0].arg == "comm":
         params.discard("comm")
     return frozenset(params), var_kw
+
+
+def _batch_consumed_keys(fn: ast.AST) -> tuple[set[str], bool]:
+    """(payload keys a batch arm reads off its message dicts, does it
+    carry a residual dict through).  Keys are the constant strings of
+    ``m.pop("k")`` / ``m.get("k")`` on bare-name receivers inside the
+    def; ``residual`` is True when such a receiver is also used whole
+    (``finishes.append((key, w, sid, m))``) — the un-popped remainder
+    travels on, so unknown keys are preserved, not dropped."""
+    keys: set[str] = set()
+    msg_vars: set[str] = set()
+    consuming_attrs: list[ast.Attribute] = []
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("pop", "get")
+            and isinstance(node.func.value, ast.Name)
+            and node.args
+        ):
+            key = astutils.const_str(node.args[0])
+            if key is not None:
+                keys.add(key)
+                msg_vars.add(node.func.value.id)
+                consuming_attrs.append(node.func)
+    if not msg_vars:
+        # no keyed reads at all: the arm forwards its messages wholesale
+        # (``self.handle(**m)``, iteration) — nothing provably drops
+        return keys, True
+    residual = False
+    consuming_attr_ids = {id(a) for a in consuming_attrs}
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Name)
+            and node.id in msg_vars
+            and isinstance(node.ctx, ast.Load)
+        ):
+            parent = astutils.parent(node)
+            # any use that is not the receiver of one of the counted
+            # pop/get calls — ``(key, w, m)`` tuples, ``**m``, ``m.items()``
+            # — carries the un-popped remainder through
+            if (
+                isinstance(parent, ast.Attribute)
+                and id(parent) in consuming_attr_ids
+            ):
+                continue
+            residual = True
+            break
+    return keys, residual
 
 
 def _is_op_lookup(node: ast.AST) -> bool:
@@ -131,6 +193,9 @@ class HandlerParityRule(Rule):
 
         # ---------------------------------------- pass 1: handler tables
         registry: dict[str, list[HandlerInfo]] = {}
+        # (op, mod, defs, handler_expr, line) per stream_batch_handlers
+        # registration, for the batch/scalar parity pass
+        batch_regs: list[tuple] = []
 
         def add(op: str, table: str, module: str, params, var_kw) -> None:
             registry.setdefault(op, []).append(
@@ -152,15 +217,25 @@ class HandlerParityRule(Rule):
                                 if op:
                                     params, var_kw = _resolve_params(v, defs)
                                     add(op, table, mod.relpath, params, var_kw)
+                                    if table == "stream_batch_handlers":
+                                        batch_regs.append(
+                                            (op, mod, defs, v, node.lineno)
+                                        )
                         elif (
                             isinstance(target, ast.Subscript)
                             and _table_name(target.value)
                         ):
                             op = astutils.const_str(target.slice)
                             if op:
+                                table = _table_name(target.value)
                                 params, var_kw = _resolve_params(node.value, defs)
-                                add(op, _table_name(target.value),  # type: ignore[arg-type]
+                                add(op, table,  # type: ignore[arg-type]
                                     mod.relpath, params, var_kw)
+                                if table == "stream_batch_handlers":
+                                    batch_regs.append(
+                                        (op, mod, defs, node.value,
+                                         node.lineno)
+                                    )
                 elif isinstance(node, ast.Call):
                     # bulk registration: X.handlers.update({...})
                     if (
@@ -177,6 +252,10 @@ class HandlerParityRule(Rule):
                             if op:
                                 params, var_kw = _resolve_params(v, defs)
                                 add(op, table, mod.relpath, params, var_kw)  # type: ignore[arg-type]
+                                if table == "stream_batch_handlers":
+                                    batch_regs.append(
+                                        (op, mod, defs, v, node.lineno)
+                                    )
                 elif isinstance(node, ast.Compare):
                     # manual dispatch: `op == "literal"` / `op in (...)` /
                     # `msg.get("op") ==/!= "literal"`
@@ -254,6 +333,69 @@ class HandlerParityRule(Rule):
                 if not any(h.accepts(msg_keys) for h in handlers):
                     yield self._kw_finding(mod, node, symbol, op, msg_keys,
                                            handlers)
+
+        # ------------------------- pass 3: batch arms vs scalar oracles
+        for op, mod, defs, handler_expr, line in batch_regs:
+            name = (astutils.dotted(handler_expr) or "").rsplit(".", 1)[-1]
+            scalars = [
+                h for h in registry.get(op, ())
+                if h.table == "stream_handlers"
+            ]
+            if not scalars:
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=line, col=0,
+                    symbol=name or op,
+                    message=(
+                        f"stream_batch_handlers[{op!r}] has no scalar "
+                        "stream handler: lone messages and direct calls "
+                        "would hit the unknown-op path"
+                    ),
+                )
+                continue
+            candidates = defs.get(name, [])
+            if len(candidates) != 1:
+                continue  # unresolvable def: nothing further to check
+            fn = candidates[0]
+            consumed, residual = _batch_consumed_keys(fn)
+            consumed -= _PROTOCOL_KEYS
+            orphan = sorted(
+                k for k in consumed if not any(h.accepts({k}) for h in scalars)
+            )
+            if orphan:
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=fn.lineno, col=0,
+                    symbol=name,
+                    message=(
+                        f"batch arm for op {op!r} consumes payload keys "
+                        f"({', '.join(orphan)}) that no scalar stream "
+                        "handler for the op accepts"
+                    ),
+                )
+            if not residual:
+                # without a carried-through residual dict, every explicit
+                # scalar payload param must be consumed explicitly or the
+                # batch plane silently drops that field
+                dropped = sorted(
+                    {
+                        p
+                        for h in scalars
+                        if h.params is not None
+                        for p in h.params
+                    }
+                    - consumed
+                    - _PROTOCOL_KEYS
+                    - _STREAM_EXTRA_KEYS
+                )
+                if dropped:
+                    yield Finding(
+                        rule=self.name, path=mod.relpath, line=fn.lineno,
+                        col=0, symbol=name,
+                        message=(
+                            f"batch arm for op {op!r} neither consumes nor "
+                            "carries through payload keys the scalar "
+                            f"handler accepts ({', '.join(dropped)})"
+                        ),
+                    )
 
     def _kw_finding(self, mod, node, symbol, op, keys, handlers) -> Finding:
         details = "; ".join(

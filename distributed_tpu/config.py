@@ -42,7 +42,6 @@ defaults: dict[str, Any] = {
         "preload": [],
         "preload-argv": [],
         "default-task-durations": {"rechunk-split": "1us", "split-shuffle": "1us"},
-        "events-cleanup-delay": "1h",
         "idle-timeout": None,
         "no-workers-timeout": None,
         "work-stealing": True,
@@ -62,8 +61,6 @@ defaults: dict[str, Any] = {
         "events-log-length": 100_000,
         "jax": {                        # the TPU co-processor (north star)
             "enabled": True,            # use device kernels when available
-            "platform": "auto",         # auto | tpu | cpu
-            "batch-size": 2048,         # stimulus batch per device step
             "min-batch": 512,           # below this, pure-python path is faster
             "min-workers": 8,           # below this the O(deps) python
                                         # oracle wins; the partitioner
@@ -99,7 +96,6 @@ defaults: dict[str, Any] = {
             # 0 disables the gate
             "min-transfer-ratio": 0.02,
             "capacity-doubling": True,  # grow SoA arrays by 2x
-            "parity-check": False,      # run python oracle in lockstep (tests)
             # persistent fleet SoA mirror (scheduler/mirror.py): delta-
             # maintained per-worker arrays shared by every co-processor
             # kernel; off = every cycle rebuilds its snapshot from
@@ -110,14 +106,11 @@ defaults: dict[str, Any] = {
         "active-memory-manager": {
             "start": True,
             "interval": "2s",
-            "measure": "optimistic",
             "policies": [{"class": "distributed_tpu.scheduler.amm.ReduceReplicas"}],
         },
     },
     "worker": {
         "blocked-handlers": [],
-        "multiprocessing-method": "spawn",
-        "use-file-locking": True,
         "transfer": {
             "message-bytes-limit": "50MB",   # yaml:89
         },
@@ -143,7 +136,6 @@ defaults: dict[str, Any] = {
         "connections": {"outgoing": 50, "incoming": 10},
         "preload": [],
         "preload-argv": [],
-        "daemon": True,
         "validate": False,
         "resources": {},
         "lifetime": {"duration": None, "stagger": "0 seconds", "restart": False},
@@ -187,14 +179,8 @@ defaults: dict[str, Any] = {
     },
     "client": {
         "heartbeat": "5s",
-        "scheduler-info-interval": "2s",
-        "security-loader": None,
         "preload": [],
         "preload-argv": [],
-    },
-    "deploy": {
-        "lost-worker-timeout": "15s",
-        "cluster-repr-interval": "500ms",
     },
     "adaptive": {
         "interval": "1s",
@@ -206,8 +192,10 @@ defaults: dict[str, Any] = {
     "comm": {
         "retry": {"count": 0, "delay": {"min": "1s", "max": "20s"}},
         "compression": False,            # yaml: compression false by default
+        # zstd codec tuning, honored when the optional `zstandard`
+        # package is present (protocol/compression.py)
+        "zstd": {"level": 3, "threads": 0},
         "shard": "64MiB",
-        "offload": "10MiB",
         # hard cap on one wire message (frame-lengths sum): a corrupt or
         # hostile header must not trigger an arbitrary-size allocation
         "max-message-bytes": "2GiB",
@@ -216,7 +204,7 @@ defaults: dict[str, Any] = {
         "receive-pool-bytes": "64MiB",
         "default-scheme": "tcp",
         "socket-backlog": 2048,
-        "timeouts": {"connect": "30s", "tcp": "30s"},
+        "timeouts": {"connect": "30s"},
         "require-encryption": None,
         "tls": {"ciphers": None, "min-version": 1.2, "ca-file": None,
                 "scheduler": {"cert": None, "key": None},
@@ -225,28 +213,14 @@ defaults: dict[str, Any] = {
     },
     "diagnostics": {
         "computations": {"max-history": 100},
-        "erred-tasks": {"max-history": 100},
     },
-    "http": {
-        "routes": ["distributed_tpu.http.routes"],
-    },
-    "dashboard": {"link": "{scheme}://{host}:{port}/status", "export-tool": False},
     "admin": {
-        "large-graph-warning-threshold": "10MB",
         # map() pickles the function once per task (specs are opaque
         # per-task leaves): flag closures that make that expensive
         "large-function-warning-bytes": "1MiB",
-        "tick": {"interval": "20ms", "limit": "3s", "cycle": "1s"},
         "max-error-length": 10_000,
-        "log-length": 10_000,
-        "log-format": "%(asctime)s - %(name)s - %(levelname)s - %(message)s",
-        "low-level-log-length": 1000,
-        "pdb-on-err": False,
-        "system-monitor": {"interval": "500ms", "log-length": 7200,
-                           "disk": True, "host-cpu": False, "gil": {"enabled": False}},
-        "event-loop": "asyncio",
+        "system-monitor": {"interval": "500ms", "log-length": 7200},
     },
-    "rmm": {"pool-size": None},
 }
 
 _lock = threading.Lock()

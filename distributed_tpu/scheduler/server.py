@@ -260,9 +260,14 @@ class Scheduler(Server):
         from distributed_tpu.diagnostics.system_monitor import SystemMonitor
         from distributed_tpu.http.server import HTTPServer, scheduler_metrics
 
-        self.monitor = SystemMonitor()
+        self.monitor = SystemMonitor(
+            maxlen=int(config.get("admin.system-monitor.log-length"))
+        )
         self.periodic_callbacks["monitor"] = PeriodicCallback(
-            self.monitor.update, 0.5
+            self.monitor.update,
+            config.parse_timedelta(
+                config.get("admin.system-monitor.interval")
+            ),
         )
         if self._http_port is not None:
             from distributed_tpu.http.dashboard import json_api_routes
@@ -1283,7 +1288,18 @@ class Scheduler(Server):
                         resp = await self.rpc(target.address).gather(
                             who_has={ts.key: [addr]}
                         )
-                        if resp.get("status") == "OK":
+                        # re-validate after the await: while the transfer
+                        # ran, the task may have been released/forgotten
+                        # (a replica record would resurrect it as a
+                        # phantom peers fetch forever) and the recipient
+                        # may have left the cluster (found by the
+                        # await-atomicity lint, rule 10)
+                        if (
+                            resp.get("status") == "OK"
+                            and s.tasks.get(ts.key) is ts
+                            and ts.state == "memory"
+                            and s.workers.get(target.address) is target
+                        ):
                             s.add_replica(ts, target)
             await self.remove_worker(addr, "retired", safe=True)
             retired.append(addr)

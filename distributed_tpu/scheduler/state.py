@@ -426,7 +426,9 @@ class SchedulerState:
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
         # one entry per update_graph batch (reference scheduler.py:864)
-        self.computations: deque[Computation] = deque(maxlen=100)
+        self.computations: deque[Computation] = deque(
+            maxlen=config.get("diagnostics.computations.max-history")
+        )
         self.task_prefixes: dict[str, TaskPrefix] = {}
         self.workers: dict[str, WorkerState] = {}
         self.aliases: dict[object, str] = {}  # name -> address

@@ -136,7 +136,14 @@ class Worker(Server):
             )
         self.state = WorkerState(
             nthreads=self.nthreads,
-            resources=resources,
+            # config fallback mirrors the reference's worker.resources
+            # yaml knob: a fleet-wide resource advertisement without
+            # per-worker CLI flags
+            resources=(
+                resources
+                if resources is not None
+                else dict(config.get("worker.resources") or {})
+            ),
             validate=validate,
             data=data,
             execute_pipeline=int(config.get("worker.execute-pipeline") or 0),
@@ -316,9 +323,14 @@ class Worker(Server):
         from distributed_tpu.diagnostics.system_monitor import SystemMonitor
         from distributed_tpu.http.server import HTTPServer, worker_metrics
 
-        self.monitor = SystemMonitor()
+        self.monitor = SystemMonitor(
+            maxlen=int(config.get("admin.system-monitor.log-length"))
+        )
         self.periodic_callbacks["monitor"] = PeriodicCallback(
-            self.monitor.update, 0.5
+            self.monitor.update,
+            config.parse_timedelta(
+                config.get("admin.system-monitor.interval")
+            ),
         )
         if self._http_port is not None:
             self.http_server = HTTPServer(

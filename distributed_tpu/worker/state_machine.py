@@ -499,6 +499,13 @@ class WorkerState:
             ("flight", "fetch"): self._transition_flight_fetch,
             ("flight", "released"): self._transition_flight_released,
             ("flight", "missing"): self._transition_flight_missing,
+            # local failure while receiving (deserialization error): a
+            # direct edge — the released fallback would park the task in
+            # "cancelled" via flight->released (previous="flight" left
+            # stale) and then release execution resources the fetch
+            # never held on the cancelled->error hop (found by the
+            # state-machine lint, rule 9)
+            ("flight", "error"): self._transition_flight_error,
             ("missing", "fetch"): self._transition_missing_fetch,
             ("missing", "released"): self._transition_generic_released,
             ("memory", "released"): self._transition_memory_released,
@@ -1147,6 +1154,15 @@ class WorkerState:
         ts.coming_from = None
         ts.state = "missing"
         return {}, []
+
+    def _transition_flight_error(self, ts, *, stimulus_id, payload=None):
+        self.in_flight_tasks.discard(ts)
+        ts.coming_from = None
+        # state is still "flight" here, so _exit_executing inside the
+        # shared error path cannot mis-release execution resources
+        return self._transition_executing_error(
+            ts, stimulus_id=stimulus_id, payload=payload
+        )
 
     def _transition_flight_released(self, ts, *, stimulus_id):
         # data may still arrive; remember to drop it

@@ -39,9 +39,10 @@ def test_registry_has_all_contract_rules():
     rules = all_rules()
     assert set(rules) >= {
         "sans-io", "monotonic-time", "blocking-in-async", "handler-parity",
-        "jit-purity", "swallowed-exceptions",
+        "jit-purity", "swallowed-exceptions", "mirror-parity",
+        "wire-no-copy", "state-machine", "await-atomicity", "config-keys",
     }
-    assert len(rules) >= 6
+    assert len(rules) >= 11
     for rule in rules.values():
         assert rule.description and rule.scope
 
@@ -654,5 +655,535 @@ def test_cli_list_rules():
     )
     assert proc.returncode == 0
     for name in ("sans-io", "monotonic-time", "blocking-in-async",
-                 "handler-parity", "jit-purity", "swallowed-exceptions"):
+                 "handler-parity", "jit-purity", "swallowed-exceptions",
+                 "state-machine", "await-atomicity", "config-keys"):
         assert name in proc.stdout
+
+
+# ------------------------------------------------- state-machine (rule 9)
+
+
+#: a minimal but complete machine: every edge reachable, every handler
+#: registered, batch arm matching its oracle
+CLEAN_MACHINE = """
+    ALL_TASK_STATES = ("released", "waiting", "memory")
+
+    class S:
+        def __init__(self):
+            self._transitions_table = {
+                ("released", "waiting"): self._transition_released_waiting,
+                ("waiting", "memory"): self._transition_waiting_memory,
+                ("waiting", "released"): self._transition_waiting_released,
+                ("memory", "released"): self._transition_memory_released,
+            }
+
+        def _transition_released_waiting(self, key, stimulus_id):
+            return {}, {}, {}
+
+        def _transition_waiting_memory(self, key, stimulus_id):
+            return {}, {}, {}
+
+        def _transition_waiting_released(self, key, stimulus_id):
+            return {}, {}, {}
+
+        def _transition_memory_released(self, key, stimulus_id):
+            return {}, {}, {}
+
+        def stimulus_done(self, ts, recommendations):
+            if ts.state == "released":
+                recommendations[ts.key] = "waiting"
+            recommendations[ts.key] = "memory"
+            recommendations[ts.key] = "released"
+            return recommendations
+"""
+
+
+def test_state_machine_clean_fixture_passes(tmp_path):
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": CLEAN_MACHINE},
+        "state-machine",
+    )
+
+
+def test_state_machine_flags_unresolvable_pair(tmp_path):
+    # (released, memory) is in no table, and the through-released
+    # fallback cannot apply when the start already IS released
+    src = CLEAN_MACHINE + """
+        def bad(self, dts, recommendations):
+            if dts.state == "released":
+                recommendations[dts.key] = "memory"
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src},
+        "state-machine",
+    )
+    pair = [f for f in found if "no registered transition" in f.message]
+    assert len(pair) == 1 and "(released, memory)" in pair[0].message
+    assert pair[0].symbol == "bad"
+
+
+def test_state_machine_accepts_released_fallback(tmp_path):
+    # (memory, waiting) missing, but memory->released and
+    # released->waiting both exist: the engine routes through released
+    src = CLEAN_MACHINE + """
+        def ok(self, dts, recommendations):
+            if dts.state == "waiting":
+                recommendations[dts.key] = "memory"   # direct
+            if dts.state == "memory":
+                recommendations[dts.key] = "waiting"  # via released
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src},
+        "state-machine",
+    )
+
+
+def test_state_machine_flags_unknown_state(tmp_path):
+    src = CLEAN_MACHINE + """
+        def typo(self, ts, recommendations):
+            recommendations[ts.key] = "wating"
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src},
+        "state-machine",
+    )
+    assert len(found) == 1 and "'wating'" in found[0].message
+
+
+def test_state_machine_flags_unreachable_edge_and_dead_handler(tmp_path):
+    src = """
+        class S:
+            def __init__(self):
+                self._transitions_table = {
+                    ("released", "waiting"): self._transition_released_waiting,
+                    ("waiting", "queued"): self._transition_waiting_queued,
+                }
+
+            def _transition_released_waiting(self, key):
+                return {}
+
+            def _transition_waiting_queued(self, key):
+                return {}
+
+            def _transition_memory_forgotten(self, key):
+                return {}
+
+            def stimulus(self, ts, recommendations):
+                recommendations[ts.key] = "waiting"
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src},
+        "state-machine",
+    )
+    msgs = "\n".join(f.message for f in found)
+    # nothing ever emits "queued": the edge is dead weight
+    assert "(waiting, queued)" in msgs and "unreachable" in msgs
+    # a handler in no table, called from nowhere
+    assert "_transition_memory_forgotten" in msgs
+    assert len(found) == 2
+
+
+def test_state_machine_flags_batch_oracle_drift(tmp_path):
+    src = CLEAN_MACHINE + """
+        def stimulus_task_done(self, key):
+            return self._transition(key, "memory", "sid")
+
+        def stimulus_tasks_done_batch(self, items):
+            for key in items:
+                self._transition(key, "released", "sid")
+
+        def stimulus_orphan_batch(self, items):
+            return items
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src},
+        "state-machine",
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "different transition surface" in msgs
+    assert "stimulus_orphan_batch" in msgs and "no scalar oracle" in msgs
+    assert len(found) == 2
+
+
+def test_state_machine_emissions_cross_module(tmp_path):
+    # an emission in a sibling scheduler module resolves against the
+    # machine owning the subpackage
+    other = """
+        def release_all(self, state, keys):
+            recs = {k: "wating" for k in keys}
+            return state.transitions(recs, "sid")
+    """
+    found = findings_for(
+        tmp_path,
+        {
+            "distributed_tpu/scheduler/state.py": CLEAN_MACHINE,
+            "distributed_tpu/scheduler/ext.py": other,
+        },
+        "state-machine",
+    )
+    assert len(found) == 1
+    assert found[0].path == "distributed_tpu/scheduler/ext.py"
+    assert "'wating'" in found[0].message
+
+
+def test_state_machine_extractor_model_and_serialization(tmp_path):
+    from distributed_tpu.analysis.config import LintConfig
+    from distributed_tpu.analysis.core import LintContext
+    from distributed_tpu.analysis.model import (
+        extract_machines,
+        machine_to_dot,
+        machine_to_json,
+    )
+
+    root = make_repo(
+        tmp_path, {"distributed_tpu/scheduler/state.py": CLEAN_MACHINE}
+    )
+    ctx = LintContext(root, LintConfig())
+    machines = extract_machines(ctx.all_modules)
+    assert len(machines) == 1
+    m = machines[0]
+    assert m.name == "scheduler"
+    assert m.states == ("memory", "released", "waiting")
+    assert {(t.start, t.finish) for t in m.transitions} == {
+        ("released", "waiting"), ("waiting", "memory"),
+        ("waiting", "released"), ("memory", "released"),
+    }
+    # every emission resolved, none flagged
+    assert m.emissions
+    assert all(
+        e.resolution in ("direct", "fallback", "any-start")
+        for e in m.emissions
+    )
+    guarded = [e for e in m.emissions if e.starts is not None]
+    assert any(
+        e.starts == ("released",) and e.finish == "waiting" for e in guarded
+    )
+    import json as _json
+
+    doc = _json.loads(machine_to_json(m))
+    assert doc["module"] == "distributed_tpu/scheduler/state.py"
+    assert len(doc["transitions"]) == 4 and len(doc["emissions"]) == len(
+        m.emissions
+    )
+    dot = machine_to_dot(m)
+    assert '"released" -> "waiting"' in dot
+    assert "_transition_released_waiting" in dot
+
+
+def test_state_machine_artifacts_no_drift(tmp_path):
+    """The checked-in docs/state_machine/ model must match a fresh
+    extraction — regenerate with
+    ``python -m distributed_tpu.analysis --dump-model docs/state_machine``
+    whenever either state machine changes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.analysis",
+         "--dump-model", str(tmp_path), "--root", str(REPO_ROOT)],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for name in ("scheduler", "worker"):
+        for ext in (".json", ".dot"):
+            fresh = (tmp_path / (name + ext)).read_text()
+            checked = (
+                REPO_ROOT / "docs" / "state_machine" / (name + ext)
+            ).read_text()
+            assert fresh == checked, (
+                f"docs/state_machine/{name}{ext} is stale — regenerate it"
+            )
+
+
+# ----------------------------------------------- await-atomicity (rule 10)
+
+
+def test_await_atomicity_fires_on_slot_reuse_steal_shape(tmp_path):
+    """Must-fire: the PR 3 slot-reuse race — a mirror-slot worker binding
+    priced into a device plan, then used to address a steal after the
+    plan await; churn during the await can reuse the slot for a
+    different worker."""
+    src = """
+        class WorkStealing:
+            async def balance_device(self):
+                state = self.scheduler.state
+                victim = state.mirror.ws_of[self.vslot]
+                plan = await self.run_device_kernel()
+                self.batched_send(victim, {"op": "steal-request",
+                                           "key": plan})
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/stealing.py": src},
+        "await-atomicity",
+    )
+    assert len(found) == 1
+    f = found[0]
+    assert f.symbol == "balance_device" and "'victim'" in f.message
+    assert "sink" in f.message
+
+
+def test_await_atomicity_fires_on_readinto_buffer_shape(tmp_path):
+    """Must-fire: the PR 4 readinto race — a StreamReader._buffer
+    binding drained after a _wait_for_data await with no exception/EOF
+    re-check (the sanctioned fix in comm/tcp.py binds via getattr and
+    raises the reader's stored exception before every drain)."""
+    src = """
+        async def readinto_exactly(reader, view):
+            n = view.nbytes
+            pos = 0
+            buffer = reader._buffer
+            while pos < n:
+                if not buffer:
+                    await reader._wait_for_data("readinto")
+                take = min(len(buffer), n - pos)
+                view[pos:pos + take] = buffer[:take]
+                del buffer[:take]
+                pos += take
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/comm/rogue.py": src}, "await-atomicity"
+    )
+    assert found, "the readinto race shape must fire"
+    assert any("'buffer'" in f.message for f in found)
+
+
+def test_await_atomicity_revalidation_and_rebind_pass(tmp_path):
+    src = """
+        class Scheduler:
+            async def guarded(self, key, addr):
+                state = self.state
+                ws = state.workers.get(addr)
+                await self.flush()
+                if state.workers.get(addr) is ws:
+                    ws.processing.pop(key, None)
+
+            async def reread(self, key):
+                state = self.state
+                ts = state.tasks.get(key)
+                nbytes = await self.fetch(ts.key)
+                ts = state.tasks.get(key)
+                ts.nbytes = nbytes
+
+            async def before_await_is_fine(self, key):
+                ts = self.state.tasks.get(key)
+                ts.nbytes = 1
+                await self.flush()
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/server.py": src},
+        "await-atomicity",
+    )
+
+
+def test_await_atomicity_pragma_suppresses(tmp_path):
+    src = """
+        async def push(self, key):
+            ts = self.state.tasks.get(key)
+            await self.flush()
+            # graft-lint: allow[await-atomicity] key is unforgettable here: pinned by the caller
+            ts.nbytes = 1
+    """
+    root = make_repo(tmp_path, {"distributed_tpu/scheduler/ext.py": src})
+    result = run_lint(root, rule_names=["await-atomicity"])
+    assert not result.findings
+    assert result.suppressed == 1
+
+
+# ------------------------------------------------------------ config-keys
+
+
+CONFIG_FIXTURE = """
+    defaults = {
+        "scheduler": {"bandwidth": 1, "dead-knob": 2},
+        "worker": {"preload": [], "nested": {"a": 1, "b": 2}},
+    }
+"""
+
+
+def test_config_keys_missing_and_dead(tmp_path):
+    reader = """
+        from distributed_tpu import config
+
+        def f(prefix):
+            config.get("scheduler.bandwidth")
+            config.get("scheduler.typo-key")
+            config.get("worker.nested")
+            config.get(f"{prefix}.preload")
+    """
+    found = findings_for(
+        tmp_path,
+        {
+            "distributed_tpu/config.py": CONFIG_FIXTURE,
+            "distributed_tpu/reader.py": reader,
+        },
+        "config-keys",
+    )
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2, found
+    assert "scheduler.typo-key" in msgs[0] and "not present" in msgs[0]
+    assert "scheduler.dead-knob" in msgs[1] and "dead configuration" in msgs[1]
+
+
+def test_config_keys_indirect_full_path_constant_counts_as_read(tmp_path):
+    reader = """
+        from distributed_tpu import config
+
+        KEY = "scheduler.dead-knob"
+
+        def f():
+            config.get("scheduler.bandwidth")
+            config.get("worker.nested")
+            config.get("worker.preload")
+            return config.get(KEY)
+    """
+    assert not findings_for(
+        tmp_path,
+        {
+            "distributed_tpu/config.py": CONFIG_FIXTURE,
+            "distributed_tpu/reader.py": reader,
+        },
+        "config-keys",
+    )
+
+
+# ------------------------------------------- handler-parity batch plane
+
+
+def test_handler_parity_batch_without_scalar_and_orphan_keys(tmp_path):
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+                self.stream_batch_handlers["task-gone"] = self.handle_gone_batch
+
+            def handle_done(self, key=None, stimulus_id=None):
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                out = []
+                for m in msgs:
+                    k = m.pop("key", None)
+                    sid = m.pop("stimulus_id", "")
+                    nb = m.pop("nbytes", 0)
+                    out.append((k, sid, nb))
+                return out
+
+            def handle_gone_batch(self, msgs):
+                return msgs
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "'task-gone'" in msgs and "no scalar stream handler" in msgs
+    assert "nbytes" in msgs and "no scalar stream handler for the op accepts" in msgs
+    assert len(found) == 2
+
+
+def test_handler_parity_batch_dropping_scalar_param_flagged(tmp_path):
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+
+            def handle_done(self, key=None, nbytes=0, stimulus_id=None):
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                return [m.pop("key", None) for m in msgs]
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    assert len(found) == 1
+    assert "neither consumes nor carries through" in found[0].message
+    assert "nbytes" in found[0].message
+
+
+def test_handler_parity_batch_residual_carry_through_passes(tmp_path):
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+
+            def handle_done(self, key=None, nbytes=0, stimulus_id=None,
+                            **kw):
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                out = []
+                for m in msgs:
+                    key = m.pop("key", None)
+                    sid = m.pop("stimulus_id", "")
+                    out.append((key, sid, m))
+                return out
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+
+
+def test_handler_parity_batch_wholesale_forward_passes(tmp_path):
+    """An arm with no keyed reads forwards its messages wholesale
+    (``**m`` delegation, ``m.items()``) — nothing provably drops, so the
+    dropped-keys claim must stay silent."""
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+                stream_handlers["task-gone"] = self.handle_gone
+                self.stream_batch_handlers["task-gone"] = self.handle_gone_batch
+
+            def handle_done(self, key=None, nbytes=0, stimulus_id=None):
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                return [self.handle_done(**m) for m in msgs]
+
+            def handle_gone(self, key=None, reason=None):
+                return key
+
+            def handle_gone_batch(self, msgs, worker=""):
+                return [sorted(m.items()) for m in msgs]
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+
+
+def test_await_atomicity_bare_annotation_is_not_a_bind(tmp_path):
+    """A value-less ``ts: TaskState`` annotation after the await binds
+    nothing — it must not move the last bind past the await and mask the
+    stale pre-await read."""
+    src = """
+        class Scheduler:
+            async def annotated(self, key):
+                ts = self.state.tasks.get(key)
+                await self.flush()
+                ts: object
+                ts.nbytes = 1
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/server.py": src},
+        "await-atomicity",
+    )
+    assert len(found) == 1
+    assert "'ts'" in found[0].message
+
+
+def test_cli_dump_model_rejects_rule_combination():
+    """--dump-model runs no rules; silently skipping a requested --rule
+    would let a CI gate pass without linting, so the combination is a
+    hard usage error."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.analysis",
+         "--dump-model", "/tmp/_should_not_exist_dump",
+         "--rule", "state-machine", "--root", str(REPO_ROOT)],
+        capture_output=True, text=True, timeout=60, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2
+    assert "pure extraction mode" in proc.stderr
+    assert not os.path.exists("/tmp/_should_not_exist_dump")

@@ -657,3 +657,71 @@ async def test_computations_resubmission_does_not_duplicate():
                 (co.id, sorted(tg.name for tg in co.groups)) for co in comps
             ]
             assert len(comps) <= n0 + 1  # at most one trailing empty
+
+
+def test_metrics_names_unique_and_documented():
+    """Every `dtpu_*` line each exposition emits must be unique (no
+    duplicate samples, Prometheus rejects them) and documented in
+    docs/wire.md / docs/scheduler_coprocessor.md — so the metric surface
+    cannot drift away from its documentation."""
+    from pathlib import Path
+
+    from distributed_tpu.http.server import scheduler_metrics, worker_metrics
+    from distributed_tpu.scheduler.state import SchedulerState
+    from distributed_tpu.worker.state_machine import WorkerState
+
+    class _Stealing:
+        count = 3
+
+    class _Sched:
+        state = SchedulerState()
+        extensions = {"stealing": _Stealing()}
+
+    # one task so the labeled per-state samples are exercised
+    _Sched.state.new_task("metrics-k", None)
+
+    class _SpillDict(dict):  # enables the spill metric lines
+        spilled_count = 0
+        slow_bytes = 0
+
+    class _Worker:
+        state = WorkerState(nthreads=1)
+        data = _SpillDict()
+        get_data_wire_bytes = 0
+
+    repo = Path(__file__).resolve().parent.parent
+    docs = "".join(
+        (repo / doc).read_text()
+        for doc in ("docs/wire.md", "docs/scheduler_coprocessor.md")
+    )
+
+    all_names: set[str] = set()
+    for blob in (scheduler_metrics(_Sched()), worker_metrics(_Worker())):
+        seen_samples: set[str] = set()
+        declared: set[str] = set()
+        for line in blob.decode().splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                name = line.split()[2]
+                assert name not in declared, f"duplicate TYPE for {name}"
+                declared.add(name)
+                continue
+            if line.startswith("#"):
+                continue
+            sample = line.rsplit(" ", 1)[0]  # "name{labels}" or "name"
+            name = sample.split("{", 1)[0]
+            assert name.startswith("dtpu_"), line
+            assert sample not in seen_samples, f"duplicate sample {sample}"
+            seen_samples.add(sample)
+            all_names.add(name)
+
+    # the full surface must be present in this test's expositions
+    assert {"dtpu_scheduler_tasks", "dtpu_worker_tasks_executing",
+            "dtpu_wire_pool_bytes", "dtpu_stealing_moves_total",
+            "dtpu_worker_spill_count_total"} <= all_names
+    undocumented = sorted(n for n in all_names if n not in docs)
+    assert not undocumented, (
+        f"metrics missing from docs/wire.md / docs/scheduler_coprocessor.md: "
+        f"{undocumented}"
+    )

@@ -554,3 +554,58 @@ async def test_resubmit_same_key_different_spec_while_erred(c, s, a, b):
     await wait_for(lambda: "respec-k" not in s.state.tasks)
     good = c.submit(inc, 41, key="respec-k", pure=False)
     assert await asyncio.wait_for(good.result(), 30) == 42
+
+
+# ------------------------------------------- await-atomicity regressions
+
+
+def test_retire_workers_revalidates_replica_landing_after_await():
+    """Regression (await-atomicity lint, rule 10): retire_workers binds
+    each unique-replica TaskState BEFORE awaiting the recipient's
+    gather.  If the task is released while the transfer runs, landing
+    the replica afterwards resurrects a forgotten task as a phantom
+    replica record peers would be sent to fetch forever.  The fix
+    re-validates task and recipient against live state after the await."""
+    import asyncio as _asyncio
+
+    from distributed_tpu.scheduler.server import Scheduler
+
+    async def body():
+        s = Scheduler(listen_addr="inproc://", http_port=None)
+        state = s.state
+        retiree = state.add_worker_state("tcp://w1:1", nthreads=1)
+        target = state.add_worker_state("tcp://w2:1", nthreads=1)
+        # a pure-data (scattered) key whose only replica lives on the
+        # retiree — exactly what retire_workers must move
+        ts = state.new_task("k", None, "released")
+        state._transition("k", "memory", "seed", worker=retiree.address,
+                          nbytes=8)
+        assert ts.state == "memory" and list(ts.who_has) == [retiree]
+
+        class _Proxy:
+            async def gather(self, who_has=None):
+                # the concurrent client release lands mid-transfer, on
+                # the same loop turn the server yielded
+                state.transitions({"k": "released"}, "concurrent-release")
+                return {"status": "OK"}
+
+            async def terminate(self):
+                return "OK"
+
+        s.rpc = lambda addr: _Proxy()
+
+        async def _remove(addr, reason, safe=False):
+            state.remove_worker_state(addr, stimulus_id="retire", safe=safe)
+
+        s.remove_worker = _remove
+        retired = await s.retire_workers(["tcp://w1:1"])
+        assert retired == ["tcp://w1:1"]
+        # pure data with no lineage: released -> forgotten, gone for good
+        assert "k" not in state.tasks
+        # the phantom replica must NOT have been landed on the survivor
+        assert ts not in target.has_what, "forgotten task resurrected"
+        assert not ts.who_has
+        assert target.nbytes == 0
+        state.validate_state()
+
+    _asyncio.run(body())

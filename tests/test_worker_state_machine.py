@@ -15,6 +15,7 @@ from distributed_tpu.worker.state_machine import (
     FreeKeysEvent,
     GatherDep,
     GatherDepBusyEvent,
+    GatherDepFailureEvent,
     GatherDepNetworkFailureEvent,
     GatherDepSuccessEvent,
     LongRunningEvent,
@@ -50,6 +51,45 @@ def finish_exec(ws, key, value=42, nbytes=8):
             nbytes=nbytes, type="int",
         )
     )
+
+
+def test_gather_dep_local_failure_errs_flight_directly(ws):
+    """Regression (state-machine lint, rule 9): a local failure while
+    receiving (deserialization error) must take the direct
+    (flight, error) edge.  Pre-fix there was no such table entry, so the
+    released fallback routed flight->released — which parks the task in
+    `cancelled` with previous="flight" left stale — and the
+    cancelled->error hop then ran executing-exit semantics, releasing
+    execution resources the fetch never held."""
+    ws.available_resources = {"gpu": 1.0}
+    ws.total_resources = {"gpu": 1.0}
+    instrs = ws.handle_stimulus(
+        ComputeTaskEvent.dummy(
+            "y", priority=(0,),
+            who_has={"dep": ["tcp://peer:1"]}, nbytes={"dep": 8},
+        )
+    )
+    assert any(isinstance(i, GatherDep) for i in instrs)
+    dep = ws.tasks["dep"]
+    assert dep.state == "flight"
+    instrs = ws.handle_stimulus(
+        GatherDepFailureEvent(
+            stimulus_id="s-fail", worker="tcp://peer:1", keys=("dep",),
+            exception=ValueError("bad frame"), traceback=None,
+        )
+    )
+    assert dep.state == "error"
+    # direct hop: no stale cancelled detour, no stale previous marker
+    assert dep.previous is None
+    hops = [(start, finish) for key, start, finish, _ in ws.story("dep")
+            if key == "dep"]
+    assert ("flight", "error") in hops
+    assert all("cancelled" not in hop for hop in hops)
+    assert any(isinstance(i, TaskErredMsg) for i in instrs)
+    # the fetch held no execution resources; none may be released
+    assert ws.available_resources == {"gpu": 1.0}
+    # unwedge for the fixture's validate: drop the dependent + dep
+    ws.handle_stimulus(FreeKeysEvent(stimulus_id="s-free", keys=("y", "dep")))
 
 
 def test_simple_execution(ws):
