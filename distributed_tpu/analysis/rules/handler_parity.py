@@ -29,7 +29,15 @@ This whole-program rule:
    every payload key the batch handler consumes (``m.pop("k")`` /
    ``m.get("k")``) must be accepted by that scalar handler, and every
    explicit scalar payload param must be consumed (or carried through a
-   residual dict) by the batch arm — so the two planes cannot drift.
+   residual dict) by the batch arm — so the two planes cannot drift;
+5. trace parity: every op on the batched plane must stamp the flight
+   recorder's ingress hop on BOTH planes — the batch arm and its scalar
+   twin each emit an ingress trace event (a ``*trace_ingress(...)``
+   helper call, or ``<...>.trace.emit("ingress", ...)``; a batch arm
+   that wholesale-delegates to an emitting scalar handler counts).  An
+   op that skips the hop is invisible to causal stimulus tracing — the
+   exact blind spot the recorder exists to remove (tracing.py,
+   docs/observability.md).
 """
 
 from __future__ import annotations
@@ -154,6 +162,34 @@ def _batch_consumed_keys(fn: ast.AST) -> tuple[set[str], bool]:
     return keys, residual
 
 
+def _emits_ingress_trace(fn: ast.AST, scalar_names: frozenset[str] = frozenset()) -> bool:
+    """Does this handler def stamp the flight recorder's ingress hop?
+
+    True for a call whose dotted tail is ``trace_ingress`` /
+    ``_trace_ingress`` (the designated helper), for a direct
+    ``<...>.trace.emit("ingress", ...)`` / ``<...>.trace.emit_task(
+    "ingress", ...)``, or — batch arms only — for a wholesale delegation
+    to a scalar handler in ``scalar_names`` (the scalar's own emission
+    then covers the batch plane transitively)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutils.dotted(node.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        if tail in ("trace_ingress", "_trace_ingress"):
+            return True
+        if (
+            tail in ("emit", "emit_task")
+            and (".trace" in f".{name}" or name.startswith("trace."))
+            and node.args
+            and astutils.const_str(node.args[0]) == "ingress"
+        ):
+            return True
+        if tail in scalar_names:
+            return True
+    return False
+
+
 def _is_op_lookup(node: ast.AST) -> bool:
     """``op`` variable or ``<msg>.get("op")`` — a dispatch-arm subject."""
     if isinstance(node, ast.Name) and node.id == "op":
@@ -196,6 +232,10 @@ class HandlerParityRule(Rule):
         # (op, mod, defs, handler_expr, line) per stream_batch_handlers
         # registration, for the batch/scalar parity pass
         batch_regs: list[tuple] = []
+        # op -> [(mod, defs, handler_expr, line)] per stream_handlers
+        # registration, for the trace-parity pass (resolving the scalar
+        # twin's def, not just its params)
+        stream_regs: dict[str, list[tuple]] = {}
 
         def add(op: str, table: str, module: str, params, var_kw) -> None:
             registry.setdefault(op, []).append(
@@ -208,8 +248,18 @@ class HandlerParityRule(Rule):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     defs.setdefault(node.name, []).append(node)
             for node in ast.walk(mod.tree):
-                if isinstance(node, ast.Assign):
-                    for target in node.targets:
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    # annotated table literals too: ``self.handlers:
+                    # dict[str, Callable] = {...}`` registers ops the
+                    # same as a bare assignment
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    if node.value is None:
+                        continue
+                    for target in targets:
                         table = _table_name(target)
                         if table and isinstance(node.value, ast.Dict):
                             for k, v in zip(node.value.keys, node.value.values):
@@ -220,6 +270,10 @@ class HandlerParityRule(Rule):
                                     if table == "stream_batch_handlers":
                                         batch_regs.append(
                                             (op, mod, defs, v, node.lineno)
+                                        )
+                                    elif table == "stream_handlers":
+                                        stream_regs.setdefault(op, []).append(
+                                            (mod, defs, v, node.lineno)
                                         )
                         elif (
                             isinstance(target, ast.Subscript)
@@ -235,6 +289,10 @@ class HandlerParityRule(Rule):
                                     batch_regs.append(
                                         (op, mod, defs, node.value,
                                          node.lineno)
+                                    )
+                                elif table == "stream_handlers":
+                                    stream_regs.setdefault(op, []).append(
+                                        (mod, defs, node.value, node.lineno)
                                     )
                 elif isinstance(node, ast.Call):
                     # bulk registration: X.handlers.update({...})
@@ -255,6 +313,10 @@ class HandlerParityRule(Rule):
                                 if table == "stream_batch_handlers":
                                     batch_regs.append(
                                         (op, mod, defs, v, node.lineno)
+                                    )
+                                elif table == "stream_handlers":
+                                    stream_regs.setdefault(op, []).append(
+                                        (mod, defs, v, node.lineno)
                                     )
                 elif isinstance(node, ast.Compare):
                     # manual dispatch: `op == "literal"` / `op in (...)` /
@@ -394,6 +456,47 @@ class HandlerParityRule(Rule):
                             f"batch arm for op {op!r} neither consumes nor "
                             "carries through payload keys the scalar "
                             f"handler accepts ({', '.join(dropped)})"
+                        ),
+                    )
+
+        # --------------------- pass 5: trace parity (ingress emission)
+        # Every batched-plane op must stamp the flight recorder's
+        # ingress hop on BOTH planes (tracing.py); ops without a scalar
+        # twin were already flagged by pass 3 and are skipped here.
+        for op, mod, defs, handler_expr, line in batch_regs:
+            scalars = stream_regs.get(op, ())
+            if not scalars:
+                continue
+            scalar_names = frozenset(
+                (astutils.dotted(expr) or "").rsplit(".", 1)[-1]
+                for _smod, _sdefs, expr, _line in scalars
+            ) - {""}
+            name = (astutils.dotted(handler_expr) or "").rsplit(".", 1)[-1]
+            candidates = defs.get(name, [])
+            if len(candidates) == 1 and not _emits_ingress_trace(
+                candidates[0], scalar_names
+            ):
+                yield Finding(
+                    rule=self.name, path=mod.relpath,
+                    line=candidates[0].lineno, col=0, symbol=name,
+                    message=(
+                        f"batch arm for op {op!r} emits no ingress trace "
+                        "event (call trace_ingress(...) or "
+                        '<...>.trace.emit("ingress", ...)): the flood is '
+                        "invisible to causal stimulus tracing"
+                    ),
+                )
+            for smod, sdefs, expr, sline in scalars:
+                sname = (astutils.dotted(expr) or "").rsplit(".", 1)[-1]
+                scands = sdefs.get(sname, [])
+                if len(scands) == 1 and not _emits_ingress_trace(scands[0]):
+                    yield Finding(
+                        rule=self.name, path=smod.relpath,
+                        line=scands[0].lineno, col=0, symbol=sname,
+                        message=(
+                            f"scalar twin of batched op {op!r} emits no "
+                            "ingress trace event: lone messages would "
+                            "vanish from causal stimulus tracing"
                         ),
                     )
 

@@ -40,7 +40,10 @@ class MonotonicTimeRule(Rule):
         "utils.misc.time and asyncio.sleep"
     )
     # everything event-loop-adjacent; ops/ is host-side numerics and
-    # utils/misc.py is where the sanctioned aliases live
+    # utils/misc.py is where the sanctioned aliases live.  tracing.py is
+    # in scope by name: flight-recorder timestamps are the causal order
+    # of the control loop, so every emission site must stamp with the
+    # monotonic utils.misc.time — an NTP step must never reorder a trace
     scope = (
         "distributed_tpu/scheduler/**",
         "distributed_tpu/worker/**",
@@ -53,6 +56,7 @@ class MonotonicTimeRule(Rule):
         "distributed_tpu/deploy/**",
         "distributed_tpu/coordination/**",
         "distributed_tpu/protocol/**",
+        "distributed_tpu/tracing.py",
     )
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:

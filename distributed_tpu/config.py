@@ -103,6 +103,20 @@ defaults: dict[str, Any] = {
             # the mirror against that oracle on every view.
             "mirror": True,
         },
+        # flight recorder (tracing.py; docs/observability.md): always-on
+        # bounded ring of causal control-loop events.  Shared by both
+        # roles — the worker's state machine reads the same subtree.
+        "trace": {
+            "enabled": True,
+            "ring-size": 16384,       # events resident per recorder
+            # 1-in-N sampling for TASK-LEVEL events (per-transition /
+            # per-worker-stimulus); batch-level events are never sampled
+            "sample": 1,
+            # record mode: capture the replayable stimulus journal
+            # (per-event dict build — off the always-on budget)
+            "journal": False,
+            "journal-size": 65536,    # stimulus records kept in record mode
+        },
         "active-memory-manager": {
             "start": True,
             "interval": "2s",

@@ -31,6 +31,16 @@ class DumpArtefact:
         self.workers: dict[str, dict] = dict(sched.get("workers") or {})
         self.transition_log: list = list(sched.get("transition_log") or [])
         self.events: dict = dict(sched.get("events") or {})
+        # flight-recorder causal tails (tracing.py): the scheduler's
+        # last-N events plus each node's, shipped in the dump by default
+        self.flight_recorder: list = list(
+            sched.get("flight_recorder") or []
+        )
+        self.worker_traces: dict[str, list] = {
+            addr: list(evs)
+            for addr, evs in (self.state.get("worker_traces") or {}).items()
+            if isinstance(evs, list)
+        }
 
     @classmethod
     def from_file(cls, path: str) -> "DumpArtefact":
@@ -88,6 +98,25 @@ class DumpArtefact:
                 out.append(row)
         return out
 
+    def trace_tail(self, *, cat: str | None = None,
+                   stim: str | None = None,
+                   node: str | None = None) -> list[dict]:
+        """Flight-recorder events from the dump, filtered by category
+        and/or stimulus id.  ``node=None`` = the scheduler's tail; a
+        worker address selects that node's.  The post-mortem twin of the
+        live ``/trace`` route: join a task's ``story`` rows against the
+        ingress/engine/egress hops that carried its stimulus."""
+        events = (
+            self.flight_recorder
+            if node is None
+            else self.worker_traces.get(node, [])
+        )
+        return [
+            ev for ev in events
+            if (cat is None or ev.get("cat") == cat)
+            and (stim is None or ev.get("stim") == stim)
+        ]
+
     def workers_summary(self) -> dict[str, dict]:
         return {
             addr: {
@@ -109,5 +138,6 @@ class DumpArtefact:
         return (
             f"<DumpArtefact tasks={len(self.tasks)} "
             f"workers={len(self.workers)} "
-            f"log={len(self.transition_log)} rows>"
+            f"log={len(self.transition_log)} rows "
+            f"trace={len(self.flight_recorder)} events>"
         )

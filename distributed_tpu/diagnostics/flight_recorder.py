@@ -1,0 +1,335 @@
+"""Flight-recorder tooling: Perfetto export + stimulus-trace replay.
+
+The in-process recorder lives in ``distributed_tpu.tracing`` (ring,
+journal, schema).  This module is the offline half:
+
+- ``to_perfetto(events)`` converts a ``/trace`` JSONL tail into the
+  Chrome ``trace_event`` JSON format — loadable in ``chrome://tracing``
+  and https://ui.perfetto.dev, one named track per event category;
+- ``replay_stimulus_trace(state, records)`` re-feeds a recorded
+  stimulus journal through the batched engine
+  (``SchedulerState.transitions_batch`` and the ``stimulus_*_batch``
+  arms) and — from the same starting state — reproduces the identical
+  transition stream (key, start, finish, order; asserted by
+  tests/test_observability.py and the bench-smoke ``trace`` gate).
+  This is the capture half of the ROADMAP item 1 deterministic
+  simulator: a recorded flood is its replay substrate;
+- the CLI::
+
+      python -m distributed_tpu.diagnostics.flight_recorder \\
+          --url http://127.0.0.1:8787/trace --perfetto out.json
+      python -m distributed_tpu.diagnostics.flight_recorder \\
+          --input trace.jsonl --perfetto out.json
+
+  reads JSONL events (live ``/trace`` endpoint, file, or stdin) and
+  writes a Perfetto trace; without ``--perfetto`` it prints a per-
+  category/stimulus summary.
+
+Schema contract: see docs/observability.md.  Every record carries
+``v`` = ``tracing.TRACE_SCHEMA_VERSION``; the exporter refuses newer
+majors rather than mis-rendering them.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Iterable
+
+from distributed_tpu.tracing import (
+    TRACE_SCHEMA_VERSION,
+    from_jsonl,
+    payload_digest,
+    to_jsonl,
+)
+
+# one synthetic thread ("track") per category so Perfetto renders the
+# control loop as parallel swimlanes: ingress above the engine above the
+# kernels above egress, with worker stimuli at the bottom
+_TRACKS = {
+    "ingress": (1, "ingress (stream ops)"),
+    "engine": (2, "engine (transition passes)"),
+    "transition": (3, "transitions (task-level, sampled)"),
+    "kernel": (4, "kernels (device co-processor)"),
+    "egress": (5, "egress (coalesced envelopes)"),
+    "wstim": (6, "worker stimuli"),
+}
+_OTHER_TRACK = (9, "other")
+
+
+def to_perfetto(events: Iterable[dict]) -> dict:
+    """Chrome ``trace_event`` JSON (the "JSON Array Format" with
+    metadata) from flight-recorder events.  Timestamps are the ring's
+    monotonic seconds scaled to microseconds — absolute values are
+    meaningless across processes, deltas and ordering are exact."""
+    events = list(events)
+    for ev in events:
+        v = ev.get("v", TRACE_SCHEMA_VERSION)
+        if v > TRACE_SCHEMA_VERSION:
+            raise ValueError(
+                f"trace schema v{v} is newer than this exporter "
+                f"(v{TRACE_SCHEMA_VERSION}); refusing to mis-render"
+            )
+    trace_events: list[dict] = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "ts": 0,
+            "pid": 0,
+            "tid": tid,
+            "args": {"name": label},
+        }
+        for tid, label in (*_TRACKS.values(), _OTHER_TRACK)
+    ]
+    for ev in events:
+        cat = ev.get("cat", "")
+        tid, _ = _TRACKS.get(cat, _OTHER_TRACK)
+        name = ev.get("name") or cat or "event"
+        if cat == "transition":
+            # name=finish, dest=start (see SchedulerState._transition)
+            name = f"{ev.get('dest', '?')}->{name}"
+        trace_events.append(
+            {
+                "name": name,
+                "cat": cat or "event",
+                "ph": "i",  # instant event
+                "s": "t",   # thread-scoped instant
+                "ts": float(ev.get("ts", 0.0)) * 1e6,
+                "pid": 0,
+                "tid": tid,
+                "args": {
+                    "stim": ev.get("stim", ""),
+                    "key": ev.get("key", ""),
+                    "n": ev.get("n", 0),
+                    "dest": ev.get("dest", ""),
+                    "seq": ev.get("seq"),
+                },
+            }
+        )
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "distributed_tpu flight recorder",
+            "schema_version": TRACE_SCHEMA_VERSION,
+        },
+    }
+
+
+# ------------------------------------------------------------------ replay
+
+
+def verify_journal(records: Iterable[dict]) -> None:
+    """Raise ``ValueError`` on an edited or truncated capture.
+
+    Two checks: every record's digest matches its payload, and the
+    ``seq`` ordinals form the contiguous run from 0 — the recorder's
+    bounded deque evicts its OLDEST records on overflow, and a journal
+    missing its head would otherwise replay cleanly from the wrong
+    starting point and present a divergent stream as faithful."""
+    for i, rec in enumerate(records):
+        want = rec.get("digest")
+        if not want:
+            # every record the recorder writes carries a digest; a
+            # missing field is itself an edit and must not silently
+            # downgrade to "unverified"
+            raise ValueError(
+                f"journal record {i} (op {rec.get('op')!r}) is missing "
+                "its payload digest"
+            )
+        if payload_digest(rec["payload"]) != want:
+            raise ValueError(
+                f"journal record {i} (op {rec.get('op')!r}, stimulus "
+                f"{rec.get('stim')!r}) fails its payload digest"
+            )
+        seq = rec.get("seq", i)
+        if seq != i:
+            raise ValueError(
+                f"journal is not a complete capture: record {i} carries "
+                f"seq {seq} (expected {i}) — the head or a middle span "
+                "was evicted/edited; raise scheduler.trace.journal-size "
+                "or start the capture with journal_start()"
+            )
+
+
+def replay_stimulus_trace(state: Any, records: Iterable[dict],
+                          verify_digests: bool = True) -> tuple[dict, dict]:
+    """Re-feed a recorded stimulus journal through the batched engine.
+
+    ``state`` must be a ``SchedulerState`` in the same starting
+    condition as the recording one was when its journal began (same
+    tasks/workers/priorities — the journal records engine *stimuli*,
+    not structural worker/task registration).  Consecutive same-op runs
+    fold through the ``stimulus_*_batch`` arms exactly as
+    ``rpc.core.handle_stream`` folds live floods, so the replayed
+    transition stream — ``transition_log`` (key, start, finish, order)
+    and the produced message multisets — is bit-identical to the
+    recorded run's (the batch arms are property-tested against the
+    scalar oracle).
+
+    Returns the merged ``(client_msgs, worker_msgs)`` the replay
+    produced — the simulator's egress, comparable envelope-for-envelope
+    against the recorded run's.
+    """
+    records = list(records)
+    if verify_digests:
+        verify_journal(records)
+    client_msgs: dict = {}
+    worker_msgs: dict = {}
+
+    def merge(cm: dict, wm: dict) -> None:
+        for dst, src in ((client_msgs, cm), (worker_msgs, wm)):
+            for k, v in src.items():
+                dst.setdefault(k, []).extend(v)
+
+    buf_op: str | None = None
+    buf: list[tuple] = []
+
+    def flush() -> None:
+        nonlocal buf_op, buf
+        if not buf:
+            return
+        if buf_op == "task-finished":
+            merge(*state.stimulus_tasks_finished_batch(buf))
+        else:
+            merge(*state.stimulus_tasks_erred_batch(buf))
+        buf_op, buf = None, []
+
+    for rec in records:
+        op = rec.get("op")
+        payload = rec.get("payload") or {}
+        if op in ("task-finished", "task-erred"):
+            if buf_op is not None and buf_op != op:
+                flush()
+            buf_op = op
+            buf.append(
+                (
+                    payload.get("key", ""),
+                    payload.get("worker", ""),
+                    rec.get("stim", ""),
+                    dict(payload.get("kwargs") or {}),
+                )
+            )
+        elif op == "release-worker-data":
+            # replica removal only: the mutation happens OUTSIDE the
+            # engine, and the engine round it recommended (if any) was
+            # journaled as its own following "transitions" record —
+            # applying the returned recs here would run it twice
+            flush()
+            state.stimulus_release_worker_data(
+                payload.get("key", ""), payload.get("worker", ""),
+                rec.get("stim", ""),
+            )
+        elif op == "transitions":
+            flush()
+            merge(
+                *state.transitions(
+                    dict(payload.get("recs") or {}), rec.get("stim", "")
+                )
+            )
+        else:
+            raise ValueError(f"unknown journal op {op!r}")
+    flush()
+    return client_msgs, worker_msgs
+
+
+def transition_stream(state: Any, since: int = 0) -> list[tuple]:
+    """The comparable (key, start, finish, stimulus_id) tuples of a
+    state's transition log from position ``since`` — what record/replay
+    parity asserts on (timestamps excluded; they are wall-dependent).
+
+    ``since`` is an index into the log as it stood when the capture
+    began.  The log is a bounded deque: once it saturates, head rows
+    are evicted and any earlier index silently points at the wrong row
+    — refuse rather than compare shifted windows."""
+    log = state.transition_log
+    if since and log.maxlen is not None and len(log) >= log.maxlen:
+        raise ValueError(
+            "transition_log wrapped during the capture (maxlen="
+            f"{log.maxlen}): the `since` anchor no longer addresses the "
+            "capture start; raise scheduler.transition-log-length or "
+            "compare shorter runs"
+        )
+    return [
+        (row[0], row[1], row[2], row[4]) for row in list(log)[since:]
+    ]
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _fetch_url(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def summarize(events: list[dict]) -> str:
+    by_cat: dict[str, int] = {}
+    stims: set[str] = set()
+    for ev in events:
+        by_cat[ev.get("cat", "?")] = by_cat.get(ev.get("cat", "?"), 0) + 1
+        if ev.get("stim"):
+            stims.add(ev["stim"])
+    lines = [f"{len(events)} events, {len(stims)} distinct stimuli"]
+    for cat, n in sorted(by_cat.items()):
+        lines.append(f"  {cat:12s} {n}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_tpu.diagnostics.flight_recorder",
+        description=(
+            "Export a flight-recorder trace (JSONL) to the Chrome/"
+            "Perfetto trace_event format, or summarize it."
+        ),
+    )
+    parser.add_argument(
+        "--input", help="trace JSONL file (default: stdin)"
+    )
+    parser.add_argument(
+        "--url",
+        help="fetch the trace from a live node's /trace route, e.g. "
+             "http://127.0.0.1:8787/trace",
+    )
+    parser.add_argument(
+        "--perfetto", metavar="OUT",
+        help="write Chrome/Perfetto trace JSON to OUT "
+             "(open in chrome://tracing or ui.perfetto.dev)",
+    )
+    parser.add_argument(
+        "--jsonl", metavar="OUT",
+        help="re-emit the (possibly url-fetched) events as JSONL to OUT",
+    )
+    args = parser.parse_args(argv)
+
+    if args.url:
+        text = _fetch_url(args.url)
+    elif args.input:
+        with open(args.input) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    events = from_jsonl(text)
+
+    wrote = False
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(to_perfetto(events), f)
+        print(f"wrote {len(events)} events to {args.perfetto}")
+        wrote = True
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            f.write(to_jsonl(events))
+        wrote = True
+    if not wrote:
+        print(summarize(events))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(main())

@@ -14,7 +14,7 @@ import contextlib
 import contextvars
 import itertools
 import uuid
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import TYPE_CHECKING, Any, Iterator
 
 from distributed_tpu.utils.misc import time
@@ -47,7 +47,8 @@ class Span:
     """Aggregated stats for one span node (reference spans.py:74)."""
 
     __slots__ = ("id", "name", "parent", "children", "states", "n_tasks",
-                 "compute_seconds", "nbytes", "start", "stop", "activity")
+                 "compute_seconds", "nbytes", "start", "stop", "activity",
+                 "recent_stimuli")
 
     def __init__(self, name: tuple[str, ...], parent: "Span | None" = None):
         self.id = f"span-{uuid.uuid4().hex[:12]}"
@@ -63,6 +64,11 @@ class Span:
         # fine performance metrics: (prefix, label, unit) -> total
         # (reference spans.py cumulative_worker_metrics)
         self.activity: defaultdict[tuple[str, str, str], float] = defaultdict(float)
+        # newest stimulus ids whose transitions fed this span (bounded):
+        # the causal join key against /trace and the flight recorder —
+        # a span's fine-metric rows can be correlated with the engine
+        # passes that scheduled its tasks
+        self.recent_stimuli: deque[str] = deque(maxlen=32)
 
     def traverse(self) -> "Iterator[Span]":
         """This span and every descendant, depth-first (reference
@@ -147,6 +153,7 @@ class Span:
             "activity": {
                 "|".join(k): v for k, v in self.activity.items()
             },
+            "recent_stimuli": list(self.recent_stimuli),
             "cumulative": cum,
             "children": children,
         }
@@ -227,6 +234,9 @@ class SpansSchedulerExtension:
             if ts.group is not None:
                 ts.group.span_id = sp.id
         sp.states[finish] += 1
+        sid = kwargs.get("stimulus_id")
+        if sid and (not sp.recent_stimuli or sp.recent_stimuli[-1] != sid):
+            sp.recent_stimuli.append(sid)
         if finish == "memory" and start == "processing":
             for ss in kwargs.get("startstops") or ():
                 if ss.get("action") == "compute":

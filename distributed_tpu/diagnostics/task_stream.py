@@ -33,6 +33,9 @@ class TaskStreamPlugin:
                     "worker": kwargs.get("worker"),
                     "startstops": list(startstops),
                     "nbytes": kwargs.get("nbytes"),
+                    # causal join key against /trace and the flight
+                    # recorder: the stimulus that produced this rectangle
+                    "stimulus_id": kwargs.get("stimulus_id", ""),
                 }
             )
             self.index += 1
@@ -44,6 +47,7 @@ class TaskStreamPlugin:
                     "worker": kwargs.get("worker"),
                     "startstops": [],
                     "error": True,
+                    "stimulus_id": kwargs.get("stimulus_id", ""),
                 }
             )
             self.index += 1

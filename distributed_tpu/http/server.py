@@ -146,6 +146,45 @@ def prom_line(name: str, value: float, labels: dict | None = None,
     return "\n".join(out)
 
 
+def prom_histogram_lines(name: str, hist: Any,
+                         help_: str | None = None) -> list[str]:
+    """Prometheus histogram exposition for a ``tracing.Histogram``:
+    cumulative ``le`` buckets + ``_sum`` + ``_count``, p50/p99-capable
+    via ``histogram_quantile`` in any Prometheus UI."""
+    lines = []
+    if help_:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for bound, count in zip(hist.bounds, hist.counts):
+        cum += count
+        le = repr(float(bound)) if bound != int(bound) else str(int(bound))
+        lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+    cum += hist.counts[-1]
+    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+    lines.append(f"{name}_sum {hist.sum}")
+    lines.append(f"{name}_count {hist.count}")
+    return lines
+
+
+def trace_metric_lines(trace: Any) -> list[str]:
+    """Flight-recorder health shared by both roles (tracing.py): a
+    recorder silently disabled or a ring too small for the flood rate is
+    observable here."""
+    return [
+        prom_line(
+            "dtpu_trace_events_total", trace.total,
+            help_="Flight-recorder events emitted since start",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_trace_ring_events", len(trace),
+            help_="Flight-recorder events currently resident in the ring",
+            type_="gauge",
+        ),
+    ]
+
+
 def wire_metric_lines() -> list[str]:
     """``dtpu_wire_*`` exposition shared by every server role: the
     zero-copy data plane counters (protocol/buffers.py).  A production
@@ -249,6 +288,18 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                     type_="counter",
                 )
             )
+    # batched-engine + egress-coalescer histograms (tracing.Histogram,
+    # observed in scheduler/state.py and Scheduler.stream_payload_flush)
+    for name, hist, help_ in (
+        ("dtpu_engine_transition_batch_size", s.hist_engine_batch,
+         "Recommendations/events folded per engine pass"),
+        ("dtpu_engine_pass_seconds", s.hist_engine_pass,
+         "Wall seconds per batched transition-engine pass"),
+        ("dtpu_egress_envelope_msgs", s.hist_egress,
+         "Messages folded per coalesced worker-stream envelope"),
+    ):
+        lines.extend(prom_histogram_lines(name, hist, help_=help_))
+    lines.extend(trace_metric_lines(s.trace))
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()
 
@@ -276,5 +327,6 @@ def worker_metrics(worker: Any) -> bytes:
                       type_="counter")
         )
         lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
+    lines.extend(trace_metric_lines(st.trace))
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -34,7 +34,9 @@ from distributed_tpu.comm.core import Comm
 from distributed_tpu.exceptions import CommClosedError
 from distributed_tpu.protocol import Serialize
 from distributed_tpu.protocol import pickle as _pickle
+from distributed_tpu.tracing import FlightRecorder
 from distributed_tpu.utils import funcname, time
+from distributed_tpu.utils.misc import seq_name
 
 logger = logging.getLogger("distributed_tpu.rpc")
 
@@ -202,6 +204,7 @@ class Server:
             "identity": self.identity,
             "echo": self.echo,
             "connection_stream": self.handle_stream,
+            "get_trace": self.get_trace,
         }
         if handlers:
             self.handlers.update(handlers)
@@ -241,6 +244,14 @@ class Server:
             connection_args=self.connection_args,
             server=self,
         )
+        # flight recorder (tracing.py): servers wrapping a state machine
+        # (Scheduler, Worker) re-point this at their state's recorder
+        # after construction so role HTTP routes and the sans-io engine
+        # share one causal timeline.  The base-Server placeholder keeps
+        # a tiny ring — nothing emits through it, and a full
+        # default-size ring here would be ~MBs of dead preallocation
+        # per Nanny/bare server
+        self.trace = FlightRecorder(ring_size=256)
         self._start_time = time()
 
     # ------------------------------------------------------------ handlers
@@ -250,6 +261,12 @@ class Server:
 
     async def echo(self, data: Any = None) -> Any:
         return data
+
+    async def get_trace(self, n: int = 200) -> list[dict]:
+        """Newest flight-recorder events (JSON-safe dicts): the RPC twin
+        of the HTTP ``/trace`` route, used by cluster dumps so chaos
+        post-mortems ship every node's causal tail by default."""
+        return self.trace.tail(n)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -462,6 +479,17 @@ class Server:
                             j += 1
                         batch = list(msgs[i:j])
                         i = j
+                        # causal stimulus ids are minted AT INGRESS: any
+                        # message folding into a batched engine pass
+                        # without one (client-plane floods; worker
+                        # messages always carry theirs) gets a fresh id
+                        # here, so the flight recorder can join the
+                        # inbound flood to the engine pass, the
+                        # transitions it produced, and the envelopes
+                        # those emitted (docs/observability.md)
+                        for m in batch:
+                            if not m.get("stimulus_id"):
+                                m["stimulus_id"] = seq_name(f"igr-{op}")
                         try:
                             result = batch_handler(batch, **extra)
                             if result is not None and inspect.isawaitable(result):

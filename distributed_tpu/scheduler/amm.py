@@ -150,6 +150,12 @@ class ActiveMemoryManagerExtension:
                     "keys": keys,
                     "stimulus_id": stimulus_id,
                 })
+            # flight-recorder kernel hop: the AMM round's decisions are
+            # joined to its stimulus id (the acquire/remove-replicas
+            # envelopes and resulting transitions carry the same id)
+            self.state.trace.emit(
+                "kernel", "amm-cycle", stimulus_id, n=len(self.pending)
+            )
             if worker_msgs:
                 self.scheduler.send_all({}, worker_msgs)
         finally:

@@ -354,8 +354,13 @@ class JaxPlacement:
     # ---------------------------------------------------------- planning
 
     def plan_graph(self, state: "SchedulerState",
-                   tasks: "dict[Key, TaskState]") -> int:
-        """One device call placing the whole batch; returns tasks planned."""
+                   tasks: "dict[Key, TaskState]",
+                   stimulus_id: str = "") -> int:
+        """One device call placing the whole batch; returns tasks planned.
+
+        ``stimulus_id`` is the submitting graph's causal id: the kernel
+        dispatch is stamped into the flight recorder under it, joining
+        the device plan to the ``update-graph`` ingress that caused it."""
         if not self.enabled:
             return 0
         # drop stale hints first: keys gone from the scheduler or no
@@ -410,6 +415,9 @@ class JaxPlacement:
             # first-of-its-kind graph exactly when the plan matters.
             return 0
         snapshot = self._snapshot(state, batch, durations, out_bytes)
+        state.trace.emit(
+            "kernel", "placement-plan", stimulus_id, n=len(batch)
+        )
 
         try:
             loop = asyncio.get_running_loop() if not self.sync else None

@@ -352,6 +352,11 @@ class SchedulerMirror:
                 )
                 self.bytes_uploaded += int(vals.nbytes)
             self.rows_uploaded += n_changed
+            # flight-recorder kernel hop: dirty-row scatter volume per
+            # device sync (a fresh cycle emits nothing — zero H2D)
+            self.state.trace.emit(
+                "kernel", "mirror-upload", "", n=n_changed, dest="scatter"
+            )
         missing = [f for f in fields if f not in self._dev]
         if missing:
             # first use of a field (or capacity growth): full upload,
@@ -359,6 +364,9 @@ class SchedulerMirror:
             for name in missing:
                 self._dev[name] = jnp.asarray(getattr(self, name))
             self.full_uploads += 1
+            self.state.trace.emit(
+                "kernel", "mirror-upload", "", n=self.cap, dest="full"
+            )
         self._device_dirty.clear()
         return {f: self._dev[f] for f in fields}
 

@@ -178,6 +178,10 @@ class Scheduler(Server):
         super().__init__(
             handlers=handlers, stream_handlers=stream_handlers, **server_kwargs
         )
+        # one causal timeline for the role: the server's flight recorder
+        # IS the state machine's (ingress/egress hops land next to the
+        # engine's transition events; /trace and get_trace serve both)
+        self.trace = self.state.trace
         self._close_begun = False
         self.extensions: dict[str, Any] = {}
         if extensions is None:
@@ -272,6 +276,8 @@ class Scheduler(Server):
         if self._http_port is not None:
             from distributed_tpu.http.dashboard import json_api_routes
 
+            from distributed_tpu.tracing import to_jsonl
+
             self.http_server = HTTPServer(
                 {
                     "/health": lambda: "ok",
@@ -279,6 +285,12 @@ class Scheduler(Server):
                     "/metrics": lambda: scheduler_metrics(self),
                     "/json/counts.json": self._counts_json,
                     "/sysmon": lambda: self.monitor.range_query(),
+                    # flight-recorder tail as JSON Lines
+                    # (docs/observability.md; schema-versioned records)
+                    "/trace": lambda: (
+                        to_jsonl(self.trace.tail()),
+                        "application/x-ndjson",
+                    ),
                     **json_api_routes(self),
                 },
                 port=self._http_port,
@@ -393,10 +405,12 @@ class Scheduler(Server):
             return
         client_msgs, self._pending_client_msgs = self._pending_client_msgs, {}
         worker_msgs, self._pending_worker_msgs = self._pending_worker_msgs, {}
+        tr = self.trace
         for client, msgs in client_msgs.items():
             bs = self.client_comms.get(client)
             if bs is None:
                 continue
+            tr.emit("egress", "client-report", "", n=len(msgs), dest=client)
             try:
                 bs.send(*[self._wrap_payload(m) for m in msgs])
             except CommClosedError:
@@ -405,13 +419,31 @@ class Scheduler(Server):
             bs = self.stream_comms.get(worker)
             if bs is None:
                 continue
+            coalesced = _coalesce_worker_stream_msgs(msgs)
+            # egress hop: one event per coalesced envelope, stamped with
+            # the envelope's (first) stimulus id so a flood's
+            # compute-tasks fan-out joins the engine pass that produced
+            # it.  Envelope fold size feeds dtpu_egress_* regardless of
+            # trace.enabled — the histogram is a documented /metrics
+            # family, not trace output.
+            hist = self.state.hist_egress
+            for m in coalesced:
+                op = m.get("op", "")
+                if op == "compute-tasks":
+                    n = len(m["tasks"])
+                    stim = m["tasks"][0].get("stimulus_id", "")
+                else:
+                    keys = m.get("keys")
+                    n = (
+                        len(keys)
+                        if isinstance(keys, (list, tuple))
+                        else 1
+                    )
+                    stim = m.get("stimulus_id", "")
+                hist.observe(n)
+                tr.emit("egress", op, stim, n=n, dest=worker)
             try:
-                bs.send(
-                    *[
-                        self._wrap_payload(m)
-                        for m in _coalesce_worker_stream_msgs(msgs)
-                    ]
-                )
+                bs.send(*[self._wrap_payload(m) for m in coalesced])
             except CommClosedError:
                 logger.info("lost connection to worker %s", worker)
                 self._ongoing_background_tasks.call_soon(
@@ -696,6 +728,7 @@ class Scheduler(Server):
         stimulus_id = stimulus_id or seq_name("update-graph")
         try:
             tasks = unwrap(tasks) or {}
+            self._trace_ingress("update-graph", len(tasks), stimulus_id)
             deps = {
                 k: set(v) for k, v in (dependencies or {}).items()
             }
@@ -750,6 +783,8 @@ class Scheduler(Server):
     def handle_client_releases_keys(self, keys: Iterable[Key] = (),
                                     client: str = "", **kw: Any) -> None:
         stimulus_id = seq_name("client-releases-keys")
+        keys = list(keys)
+        self._trace_ingress("client-releases-keys", len(keys), stimulus_id)
         client_msgs, worker_msgs = self.state.client_releases_keys(
             keys, client, stimulus_id
         )
@@ -757,11 +792,20 @@ class Scheduler(Server):
 
     # ----------------------------------------------------- worker stream ops
 
+    def _trace_ingress(self, op: str, n: int, stimulus_id: str) -> None:
+        """Flight-recorder ingress hop: a stream op entered the control
+        loop.  Every op on the batched plane (``stream_batch_handlers``)
+        and its scalar twin MUST pass through here — enforced by the
+        handler-parity lint's trace-parity pass (docs/analysis.md)."""
+        self.trace.emit("ingress", op, stimulus_id, n=n)
+
     def handle_task_finished(self, key: Key = "", worker: str = "",
                              stimulus_id: str = "", **kwargs: Any) -> None:
         kwargs.pop("op", None)
+        stimulus_id = stimulus_id or seq_name("task-finished")
+        self._trace_ingress("task-finished", 1, stimulus_id)
         client_msgs, worker_msgs = self.state.stimulus_task_finished(
-            key, worker, stimulus_id or seq_name("task-finished"), **kwargs
+            key, worker, stimulus_id, **kwargs
         )
         self.send_all(client_msgs, worker_msgs)
 
@@ -769,10 +813,12 @@ class Scheduler(Server):
                           stimulus_id: str = "", exception: Any = None,
                           traceback: Any = None, **kwargs: Any) -> None:
         kwargs.pop("op", None)
+        stimulus_id = stimulus_id or seq_name("task-erred")
+        self._trace_ingress("task-erred", 1, stimulus_id)
         client_msgs, worker_msgs = self.state.stimulus_task_erred(
             key,
             worker,
-            stimulus_id or seq_name("task-erred"),
+            stimulus_id,
             # opaque: user exceptions may be classes this process cannot
             # import; they are stored and forwarded as-is, and the
             # worker-supplied exception_text covers scheduler-side logs
@@ -792,6 +838,10 @@ class Scheduler(Server):
             w = m.pop("worker", "") or worker
             stimulus_id = m.pop("stimulus_id", "") or seq_name("task-finished")
             finishes.append((key, w, stimulus_id, m))
+        self._trace_ingress(
+            "task-finished", len(finishes),
+            finishes[0][2] if finishes else "",
+        )
         client_msgs, worker_msgs = self.state.stimulus_tasks_finished_batch(
             finishes
         )
@@ -807,6 +857,9 @@ class Scheduler(Server):
             w = m.pop("worker", "") or worker
             stimulus_id = m.pop("stimulus_id", "") or seq_name("task-erred")
             errors.append((key, w, stimulus_id, m))
+        self._trace_ingress(
+            "task-erred", len(errors), errors[0][2] if errors else ""
+        )
         client_msgs, worker_msgs = self.state.stimulus_tasks_erred_batch(errors)
         self.send_all(client_msgs, worker_msgs)
 
@@ -817,37 +870,33 @@ class Scheduler(Server):
         round exactly like sequential per-message handling, while all
         rounds drain into one shared message pair."""
         state = self.state
+        self._trace_ingress(
+            "release-worker-data", len(msgs),
+            (msgs[0].get("stimulus_id") or "") if msgs else "",
+        )
 
         def rounds():
             for m in msgs:
                 key = m.get("key", "")
                 w = m.get("worker", "") or worker
-                ts = state.tasks.get(key)
-                ws = state.workers.get(w)
-                if ts is None or ws is None:
-                    continue
-                if ws in ts.who_has:
-                    state.remove_replica(ts, ws)
-                if not ts.who_has:
-                    yield (
-                        {key: "released"},
-                        m.get("stimulus_id") or seq_name("release-data"),
-                    )
+                stimulus_id = m.get("stimulus_id") or seq_name("release-data")
+                recs = state.stimulus_release_worker_data(key, w, stimulus_id)
+                if recs:
+                    yield (recs, stimulus_id)
 
         client_msgs, worker_msgs = state.transitions_batch(rounds())
         self.send_all(client_msgs, worker_msgs)
 
     def handle_release_data(self, key: Key = "", worker: str = "",
                             stimulus_id: str = "", **kwargs: Any) -> None:
-        ts = self.state.tasks.get(key)
-        ws = self.state.workers.get(worker)
-        if ts is None or ws is None:
-            return
-        if ws in ts.who_has:
-            self.state.remove_replica(ts, ws)
-        if not ts.who_has:
+        stimulus_id = stimulus_id or seq_name("release-data")
+        self._trace_ingress("release-worker-data", 1, stimulus_id)
+        recs = self.state.stimulus_release_worker_data(
+            key, worker, stimulus_id
+        )
+        if recs:
             client_msgs, worker_msgs = self.state.transitions(
-                {key: "released"}, stimulus_id or seq_name("release-data")
+                recs, stimulus_id
             )
             self.send_all(client_msgs, worker_msgs)
 
@@ -1732,8 +1781,21 @@ class Scheduler(Server):
             scheduler_info["transition_log"] = [
                 list(row) for row in list(s.transition_log)[-5000:]
             ]
-        worker_info = await self.broadcast(msg={"op": "identity"})
-        return {"scheduler": scheduler_info, "workers": worker_info}
+        out = {"scheduler": scheduler_info}
+        if "flight_recorder" not in (exclude or ()):
+            # every node's causal tail ships in the dump by default
+            # (bounded, JSON-safe): chaos post-mortems can join the
+            # scheduler's ingress/engine/egress hops against each
+            # worker's stimulus events without a live cluster.  The two
+            # cluster-wide broadcasts are independent: gather them.
+            scheduler_info["flight_recorder"] = self.trace.tail(500)
+            out["worker_traces"], out["workers"] = await asyncio.gather(
+                self.broadcast(msg={"op": "get_trace", "n": 200}),
+                self.broadcast(msg={"op": "identity"}),
+            )
+        else:
+            out["workers"] = await self.broadcast(msg={"op": "identity"})
+        return out
 
     def _counts_json(self) -> dict:
         s = self.state

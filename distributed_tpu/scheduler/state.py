@@ -44,6 +44,12 @@ from distributed_tpu.exceptions import (
 )
 from distributed_tpu.graph.spec import TaskSpec
 from distributed_tpu.protocol.serialize import compact_frames, wrap_opaque
+from distributed_tpu.tracing import (
+    SECONDS_BUCKETS,
+    SIZE_BUCKETS,
+    FlightRecorder,
+    Histogram,
+)
 from distributed_tpu.utils import HeapSet, key_split, time
 
 logger = logging.getLogger("distributed_tpu.scheduler")
@@ -423,6 +429,18 @@ class SchedulerState:
         placement: Any | None = None,
         mirror: bool | None = None,
     ):
+        # flight recorder + engine histograms (tracing.py;
+        # docs/observability.md) — created FIRST: worker registration and
+        # the mirror emit through them during the rest of this __init__
+        self.trace = FlightRecorder()
+        # recommendations per engine pass / flood fold size
+        self.hist_engine_batch = Histogram(SIZE_BUCKETS)
+        # wall seconds per engine pass (one flood fold or one
+        # recommendation round drained to its fixed point)
+        self.hist_engine_pass = Histogram(SECONDS_BUCKETS)
+        # messages folded per coalesced egress envelope (server-side
+        # observe site: Scheduler.stream_payload_flush)
+        self.hist_egress = Histogram(SIZE_BUCKETS)
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
         # one entry per update_graph batch (reference scheduler.py:864)
@@ -644,6 +662,13 @@ class SchedulerState:
         self.transition_log.append(
             (key, start, actual_finish, dict(recommendations), stimulus_id, time())
         )
+        # task-level trace hop (sampled 1-in-N): name=finish, dest=start
+        # — interned strings only, so the flood fast path allocates
+        # nothing (the bench-smoke "trace" gate enforces both the alloc
+        # contract and the <5% traced-on overhead)
+        self.trace.emit_task(
+            "transition", actual_finish, stimulus_id, key=key, dest=start
+        )
         if self.validate:
             self.validate_task_state(ts)
         if self.plugins:
@@ -681,9 +706,21 @@ class SchedulerState:
 
     def transitions(self, recommendations: dict[Key, str], stimulus_id: str) -> tuple[dict, dict]:
         """Public entry: process recommendations, return (client_msgs, worker_msgs)."""
+        tr = self.trace
+        if tr.journal_enabled:
+            tr.record(
+                "transitions", {"recs": dict(recommendations)}, stimulus_id
+            )
         client_msgs: dict = {}
         worker_msgs: dict = {}
+        t0 = time()
         self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+        # histograms observe regardless of trace.enabled: dtpu_engine_*
+        # are documented /metrics families, not trace output
+        n = len(recommendations)
+        self.hist_engine_batch.observe(n)
+        self.hist_engine_pass.observe(time() - t0)
+        tr.emit("engine", "transitions", stimulus_id, n=n)
         return client_msgs, worker_msgs
 
     def story(self, *keys_or_stimuli: Key) -> list[tuple]:
@@ -1935,6 +1972,12 @@ class SchedulerState:
         self, key: Key, worker: str, stimulus_id: str, **kwargs: Any
     ) -> tuple[dict, dict]:
         """A worker reported a finished task (reference scheduler.py:5025)."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "task-finished",
+                {"key": key, "worker": worker, "kwargs": dict(kwargs)},
+                stimulus_id,
+            )
         ts = self.tasks.get(key)
         if ts is None or ts.state in ("released", "forgotten", "erred"):
             # stale completion for a cancelled task: tell worker to drop it
@@ -1975,6 +2018,22 @@ class SchedulerState:
         **kwargs: Any,
     ) -> tuple[dict, dict]:
         """A worker reported a task failure (reference scheduler.py:5106)."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "task-erred",
+                {
+                    "key": key,
+                    "worker": worker,
+                    "kwargs": {
+                        "exception": exception,
+                        "traceback": traceback,
+                        "exception_text": exception_text,
+                        "traceback_text": traceback_text,
+                        **kwargs,
+                    },
+                },
+                stimulus_id,
+            )
         ts = self.tasks.get(key)
         if ts is None or ts.state != "processing":
             return {}, {}
@@ -2036,7 +2095,14 @@ class SchedulerState:
         dict churn and per-round send."""
         client_msgs: dict = {}
         worker_msgs: dict = {}
+        tr = self.trace
         for recommendations, stimulus_id in batches:
+            if tr.journal_enabled:
+                tr.record(
+                    "transitions", {"recs": dict(recommendations)},
+                    stimulus_id,
+                )
+            t0 = time()
             # fault isolation matches the per-message path (one logged
             # failure per message, the rest of the payload proceeds):
             # a poison round must not discard the messages of rounds
@@ -2050,6 +2116,10 @@ class SchedulerState:
                     "batched transition round failed (stimulus %s)",
                     stimulus_id,
                 )
+            n = len(recommendations)
+            self.hist_engine_batch.observe(n)
+            self.hist_engine_pass.observe(time() - t0)
+            tr.emit("engine", "transitions", stimulus_id, n=n)
         return client_msgs, worker_msgs
 
     def stimulus_tasks_finished_batch(
@@ -2068,7 +2138,17 @@ class SchedulerState:
         """
         client_msgs: dict = {}
         worker_msgs: dict = {}
+        if not isinstance(finishes, (list, tuple)):
+            finishes = list(finishes)
+        tr = self.trace
+        t0 = time()
         for key, worker, stimulus_id, kwargs in finishes:
+            if tr.journal_enabled:
+                tr.record(
+                    "task-finished",
+                    {"key": key, "worker": worker, "kwargs": dict(kwargs)},
+                    stimulus_id,
+                )
             # per-event fault isolation, same as the per-message path
             # (handle_stream logs one failure and proceeds): a poison
             # event must not discard the flood's already-accumulated
@@ -2113,6 +2193,13 @@ class SchedulerState:
                     "batched task-finished event failed (%s from %s, "
                     "stimulus %s)", key, worker, stimulus_id,
                 )
+        if finishes:
+            self.hist_engine_batch.observe(len(finishes))
+            self.hist_engine_pass.observe(time() - t0)
+            tr.emit(
+                "engine", "task-finished-batch", finishes[0][2],
+                n=len(finishes),
+            )
         return client_msgs, worker_msgs
 
     def stimulus_tasks_erred_batch(
@@ -2124,7 +2211,17 @@ class SchedulerState:
         as :meth:`stimulus_tasks_finished_batch`."""
         client_msgs: dict = {}
         worker_msgs: dict = {}
+        if not isinstance(errors, (list, tuple)):
+            errors = list(errors)
+        tr = self.trace
+        t0 = time()
         for key, worker, stimulus_id, kwargs in errors:
+            if tr.journal_enabled:
+                tr.record(
+                    "task-erred",
+                    {"key": key, "worker": worker, "kwargs": dict(kwargs)},
+                    stimulus_id,
+                )
             try:
                 ts = self.tasks.get(key)
                 if ts is None or ts.state != "processing":
@@ -2152,7 +2249,43 @@ class SchedulerState:
                     "batched task-erred event failed (%s from %s, "
                     "stimulus %s)", key, worker, stimulus_id,
                 )
+        if errors:
+            self.hist_engine_batch.observe(len(errors))
+            self.hist_engine_pass.observe(time() - t0)
+            tr.emit(
+                "engine", "task-erred-batch", errors[0][2], n=len(errors)
+            )
         return client_msgs, worker_msgs
+
+    def stimulus_release_worker_data(
+        self, key: Key, worker: str, stimulus_id: str
+    ) -> dict[Key, str]:
+        """A worker no longer holds a replica (pure part of the
+        ``release-worker-data`` handlers): drop the replica record and
+        recommend ``released`` when it was the last one.
+
+        Journaled as its own op: the replica removal is a state mutation
+        OUTSIDE the transition engine, so a capture that only recorded
+        the engine rounds would replay it un-removed and diverge.  The
+        returned recommendations are fed through ``transitions`` /
+        ``transitions_batch`` by the caller, which journals that round
+        separately — replay applies this op's removal only and lets the
+        following ``transitions`` record drive the engine."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "release-worker-data",
+                {"key": key, "worker": worker},
+                stimulus_id,
+            )
+        ts = self.tasks.get(key)
+        ws = self.workers.get(worker)
+        if ts is None or ws is None:
+            return {}
+        if ws in ts.who_has:
+            self.remove_replica(ts, ws)
+        if not ts.who_has:
+            return {key: "released"}
+        return {}
 
     def stimulus_retry(self, keys: Iterable[Key], stimulus_id: str) -> tuple[dict, dict]:
         """Re-run erred tasks (reference scheduler.py:5131)."""
@@ -2512,10 +2645,13 @@ class SchedulerState:
 
         if self.placement is not None and hasattr(self.placement, "plan_graph"):
             # one device call plans the whole incoming graph; consumed as
-            # per-task hints by decide_worker_non_rootish
+            # per-task hints by decide_worker_non_rootish.  The graph's
+            # stimulus id rides along so the kernel dispatch joins the
+            # submission in the flight recorder.
             try:
                 self.placement.plan_graph(
-                    self, {ts.key: ts for ts in touched}
+                    self, {ts.key: ts for ts in touched},
+                    stimulus_id=stimulus_id,
                 )
             except Exception:
                 logger.exception("placement planning failed")

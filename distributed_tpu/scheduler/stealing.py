@@ -378,6 +378,9 @@ class WorkStealing:
                 return
             except Exception:
                 logger.exception("device balance failed; python fallback")
+        # flight-recorder kernel hop: one event per host-path cycle
+        # (the device path stamps its own in _balance_device)
+        s.trace.emit("kernel", "steal-cycle", "", n=n_stealable, dest="host")
         if s.saturated:
             victims = list(s.saturated)
         else:
@@ -462,6 +465,9 @@ class WorkStealing:
 
         max_rank = (1 << _RANK_BITS) - 1
         s = self.state
+        s.trace.emit(
+            "kernel", "steal-cycle", "", n=len(idle_workers), dest="device"
+        )
         mirror = s.mirror
         overlay_slots: list[int] = []
         overlay_vals: list[float] = []

@@ -239,6 +239,10 @@ class Worker(Server):
             handlers=handlers, stream_handlers=stream_handlers, name=name,
             **server_kwargs,
         )
+        # one causal timeline for the role: the server's flight recorder
+        # IS the state machine's (the /trace route and get_trace RPC
+        # serve the sans-io engine's stimulus events)
+        self.trace = self.state.trace
         self.name = name if name is not None else self.id
         from distributed_tpu.shuffle.core import ShuffleWorkerExtension
 
@@ -333,12 +337,19 @@ class Worker(Server):
             ),
         )
         if self._http_port is not None:
+            from distributed_tpu.tracing import to_jsonl
+
             self.http_server = HTTPServer(
                 {
                     "/health": lambda: "ok",
                     "/info": self.identity,
                     "/metrics": lambda: worker_metrics(self),
                     "/sysmon": lambda: self.monitor.range_query(),
+                    # flight-recorder tail (docs/observability.md)
+                    "/trace": lambda: (
+                        to_jsonl(self.trace.tail()),
+                        "application/x-ndjson",
+                    ),
                 },
                 port=self._http_port,
             )

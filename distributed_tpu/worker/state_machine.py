@@ -36,6 +36,7 @@ from typing import Any, Callable
 
 from distributed_tpu import config
 from distributed_tpu.exceptions import InvalidTaskState, InvalidTransition
+from distributed_tpu.tracing import FlightRecorder
 from distributed_tpu.utils import HeapSet
 
 logger = logging.getLogger("distributed_tpu.worker.state")
@@ -468,6 +469,10 @@ class WorkerState:
         self.transition_counter = 0
         self.log: deque = deque(maxlen=100_000)
         self.stimulus_log: deque = deque(maxlen=10_000)
+        # flight recorder (tracing.py): stimulus batches land here with
+        # the same scheduler-minted stimulus ids the scheduler's ring
+        # carries, so /trace on both roles joins on one causal id
+        self.trace = FlightRecorder()
         self.rng = random.Random(0)  # deterministic (reference wsm.py:1328)
         self.task_counter: defaultdict[str, int] = defaultdict(int)
 
@@ -541,8 +546,13 @@ class WorkerState:
         per message (measured 1.4 keys per gather on the tensordot
         bench, with per-request loop cost dwarfing the payload)."""
         instructions: Instructions = []
+        tr = self.trace
         for event in events:
             self.stimulus_log.append(event)
+            # task-level trace hop (sampled): the payload-boundary batch
+            # arrives as one handle_stimulus call, so each event's
+            # stimulus id joins the scheduler envelope that carried it
+            tr.emit_task("wstim", type(event).__name__, event.stimulus_id)
             handler = getattr(self, "_handle_" + _snake(type(event).__name__))
             recs, instr = handler(event)
             instructions += instr

@@ -1056,9 +1056,11 @@ def test_handler_parity_batch_without_scalar_and_orphan_keys(tmp_path):
                 self.stream_batch_handlers["task-gone"] = self.handle_gone_batch
 
             def handle_done(self, key=None, stimulus_id=None):
+                self._trace_ingress("task-done", 1, stimulus_id)
                 return key
 
             def handle_done_batch(self, msgs, worker=""):
+                self._trace_ingress("task-done", len(msgs), "")
                 out = []
                 for m in msgs:
                     k = m.pop("key", None)
@@ -1087,9 +1089,11 @@ def test_handler_parity_batch_dropping_scalar_param_flagged(tmp_path):
                 self.stream_batch_handlers["task-done"] = self.handle_done_batch
 
             def handle_done(self, key=None, nbytes=0, stimulus_id=None):
+                self._trace_ingress("task-done", 1, stimulus_id)
                 return key
 
             def handle_done_batch(self, msgs, worker=""):
+                self._trace_ingress("task-done", len(msgs), "")
                 return [m.pop("key", None) for m in msgs]
     """
     found = findings_for(
@@ -1109,9 +1113,11 @@ def test_handler_parity_batch_residual_carry_through_passes(tmp_path):
 
             def handle_done(self, key=None, nbytes=0, stimulus_id=None,
                             **kw):
+                self._trace_ingress("task-done", 1, stimulus_id)
                 return key
 
             def handle_done_batch(self, msgs, worker=""):
+                self._trace_ingress("task-done", len(msgs), "")
                 out = []
                 for m in msgs:
                     key = m.pop("key", None)
@@ -1137,20 +1143,92 @@ def test_handler_parity_batch_wholesale_forward_passes(tmp_path):
                 self.stream_batch_handlers["task-gone"] = self.handle_gone_batch
 
             def handle_done(self, key=None, nbytes=0, stimulus_id=None):
+                self._trace_ingress("task-done", 1, stimulus_id)
                 return key
 
             def handle_done_batch(self, msgs, worker=""):
                 return [self.handle_done(**m) for m in msgs]
 
             def handle_gone(self, key=None, reason=None):
+                self.trace.emit("ingress", "task-gone", "")
                 return key
 
             def handle_gone_batch(self, msgs, worker=""):
+                self.trace.emit("ingress", "task-gone", "", n=len(msgs))
                 return [sorted(m.items()) for m in msgs]
     """
     assert not findings_for(
         tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
     )
+    # note: handle_done_batch carries NO emission of its own — the
+    # wholesale delegation to the emitting scalar covers the batch
+    # plane transitively (trace-parity pass 5)
+
+
+def test_handler_parity_trace_parity_must_fire(tmp_path):
+    """Trace-parity (pass 5): a batched op whose arms never stamp the
+    flight recorder's ingress hop is flagged on BOTH planes — the blind
+    spot causal stimulus tracing exists to remove."""
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+
+            def handle_done(self, key=None, stimulus_id=None):
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                out = []
+                for m in msgs:
+                    out.append((m.pop("key", None), m.pop("stimulus_id", ""), m))
+                return out
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "emits no ingress trace" in msgs
+    assert "batch arm for op 'task-done'" in msgs
+    assert "scalar twin of batched op 'task-done'" in msgs
+    assert len(found) == 2
+
+
+def test_handler_parity_trace_parity_accepts_direct_emit_and_helper(tmp_path):
+    """Both sanctioned emission shapes pass: the ``*trace_ingress``
+    helper and a direct ``<...>.trace.emit("ingress", ...)``; an emit
+    with a NON-ingress category does not count."""
+    src = """
+        class Server:
+            def __init__(self):
+                stream_handlers = {"task-done": self.handle_done}
+                self.stream_batch_handlers["task-done"] = self.handle_done_batch
+                stream_handlers["task-gone"] = self.handle_gone
+                self.stream_batch_handlers["task-gone"] = self.handle_gone_batch
+
+            def handle_done(self, key=None, stimulus_id=None):
+                self.trace.emit("ingress", "task-done", stimulus_id)
+                return key
+
+            def handle_done_batch(self, msgs, worker=""):
+                self._trace_ingress("task-done", len(msgs), "")
+                return [(m.pop("key", None), m.pop("stimulus_id", ""), m)
+                        for m in msgs]
+
+            def handle_gone(self, key=None, stimulus_id=None):
+                self.trace.emit("engine", "not-ingress", stimulus_id)
+                return key
+
+            def handle_gone_batch(self, msgs, worker=""):
+                self._trace_ingress("task-gone", len(msgs), "")
+                return [(m.pop("key", None), m.pop("stimulus_id", ""), m)
+                        for m in msgs]
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/worker/srv.py": src}, "handler-parity"
+    )
+    assert len(found) == 1
+    assert "scalar twin of batched op 'task-gone'" in found[0].message
 
 
 def test_await_atomicity_bare_annotation_is_not_a_bind(tmp_path):

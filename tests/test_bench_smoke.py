@@ -55,3 +55,16 @@ def test_bench_smoke_runs_and_reports():
     assert wire["pool_hits"] > 0
     for label in ("1KB", "64KB", "8MB"):
         assert wire["mb_s"][label] > 0
+    # flight recorder (tracing.py, docs/observability.md): traced-on
+    # engine floods stay under the 5% overhead budget (same-session
+    # canary-stamped A/B), the fast-path emit allocates nothing, and a
+    # recorded stimulus journal replays to the identical transition
+    # stream — the bench half raises on any violation, these asserts
+    # pin the contract in the gate's own output
+    trace = out["configs"]["trace"]
+    assert trace["overhead_pct"] < 5.0
+    assert trace["alloc_delta_blocks"] < 50
+    assert trace["replay_match"] is True
+    assert trace["replay_rows"] > 0
+    assert trace["n_events"] > 0
+    assert trace["host_canary_ms"] > 0

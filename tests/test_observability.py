@@ -88,6 +88,13 @@ async def test_task_stream_records():
             rec = stream[0]
             assert rec["worker"] is not None
             assert rec["startstops"] and rec["startstops"][0]["action"] == "compute"
+            # every rectangle carries the stimulus id of the transition
+            # that produced it — the join key against /trace (PR 6)
+            assert all(r["stimulus_id"] for r in stream)
+            trace_stims = {
+                ev["stim"] for ev in cluster.scheduler.trace.tail()
+            }
+            assert {r["stimulus_id"] for r in stream} <= trace_stims
 
 
 @gen_test(timeout=60)
@@ -473,6 +480,15 @@ async def test_cluster_dump_artefact_roundtrip():
     assert any(row[0] == key for row in story)
     summary = d.workers_summary()
     assert all(v["nthreads"] == 1 for v in summary.values())
+    # the flight-recorder causal tails ship in the dump by default
+    # (PR 6): scheduler last-N plus each node's, and the trace joins
+    # the dumped story rows on stimulus id
+    assert d.flight_recorder, "scheduler flight-recorder tail missing"
+    assert d.trace_tail(cat="engine"), d.flight_recorder[:5]
+    assert len(d.worker_traces) == 2, list(d.worker_traces)
+    assert all(evs for evs in d.worker_traces.values())
+    sid = story[0][4]
+    assert d.trace_tail(stim=sid), f"no trace events for stimulus {sid}"
     tdir.cleanup()
 
 
@@ -589,6 +605,299 @@ async def test_eventstream_topic():
             assert len(await c.get_events(topic)) == n  # stopped
 
 
+# --------------------------------------------------------- flight recorder
+
+
+def _build_trace_state(n_workers=4, n_tasks=60):
+    """Deterministic SchedulerState + pending graph for record/replay
+    tests (same construction = same starting state, the replay
+    contract's precondition; docs/observability.md)."""
+    from distributed_tpu.graph.spec import TaskSpec
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    state = SchedulerState(validate=True)
+    for i in range(n_workers):
+        state.add_worker_state(
+            f"tcp://fr:{i}", nthreads=2, memory_limit=2**30, name=f"fr{i}"
+        )
+    tasks = {f"fr-{i}": TaskSpec(lambda: i) for i in range(n_tasks)}
+    deps = {f"fr-{i}": set() for i in range(n_tasks)}
+    # a dependent layer so the flood cascades through waiting->processing
+    for i in range(0, n_tasks, 3):
+        tasks[f"frd-{i}"] = TaskSpec(lambda x: x)
+        deps[f"frd-{i}"] = {f"fr-{i}", f"fr-{(i + 1) % n_tasks}"}
+    state.update_graph_core(
+        tasks, deps, list(tasks), client="frc",
+        stimulus_id="fr-graph",
+    )
+    return state
+
+
+def _flood_to_memory(state):
+    """Report every processing task finished, in payload-sized batches,
+    until the whole graph is in memory — the multi-flood run."""
+    rounds = 0
+    while True:
+        batch = [
+            (ts.key, ws.address, f"fr-fin-{ts.key}", {"nbytes": 16})
+            for ws in state.workers.values()
+            for ts in list(ws.processing)
+        ]
+        if not batch:
+            break
+        state.stimulus_tasks_finished_batch(batch)
+        rounds += 1
+        assert rounds < 10_000
+    return rounds
+
+
+def test_record_replay_round_trip():
+    """ACCEPTANCE (PR 6): a recorded stimulus trace of a multi-flood run
+    re-fed through the batched engine offline reproduces the identical
+    transition stream (key, start, finish, stimulus, order)."""
+    from distributed_tpu.diagnostics.flight_recorder import (
+        replay_stimulus_trace,
+        transition_stream,
+        verify_journal,
+    )
+
+    rec = _build_trace_state()
+    mark = len(rec.transition_log)
+    rec.trace.journal_start()
+    rounds = _flood_to_memory(rec)
+    assert rounds >= 2, "not a multi-flood run"
+    records = list(rec.trace.journal)
+    assert records and all(r["v"] == 1 for r in records)
+    assert all(r["op"] in ("task-finished", "transitions") for r in records)
+    verify_journal(records)
+
+    rep = _build_trace_state()
+    mark_b = len(rep.transition_log)
+    cm, wm = replay_stimulus_trace(rep, records)
+    recorded = transition_stream(rec, mark)
+    replayed = transition_stream(rep, mark_b)
+    assert recorded, "flood produced no transitions"
+    assert recorded == replayed
+    # terminal states agree too, not just the log
+    assert {k: ts.state for k, ts in rec.tasks.items()} == {
+        k: ts.state for k, ts in rep.tasks.items()
+    }
+    # an edited journal must refuse to replay...
+    import pytest
+
+    tampered = [dict(r) for r in records]
+    tampered[3] = dict(tampered[3], payload={"key": "tampered"})
+    with pytest.raises(ValueError, match="digest"):
+        replay_stimulus_trace(_build_trace_state(), tampered)
+    # ...and so must a head-truncated one (deque overflow evicts the
+    # OLDEST records; replaying from the wrong start would silently
+    # present a divergent stream as faithful)
+    with pytest.raises(ValueError, match="complete capture"):
+        replay_stimulus_trace(_build_trace_state(), records[2:])
+
+
+def test_record_replay_erred_and_transitions_ops():
+    """The journal covers the erred arm and bare recommendation rounds,
+    and replay folds mixed consecutive runs correctly."""
+    from distributed_tpu.diagnostics.flight_recorder import (
+        replay_stimulus_trace,
+        transition_stream,
+    )
+
+    def drive(state):
+        state.trace.journal_start()
+        procs = [
+            (ts.key, ws.address)
+            for ws in state.workers.values()
+            for ts in list(ws.processing)
+        ]
+        fin = [(k, a, f"mx-fin-{k}", {"nbytes": 8}) for k, a in procs[:3]]
+        err = [
+            (k, a, f"mx-err-{k}", {"exception_text": "boom"})
+            for k, a in procs[3:5]
+        ]
+        state.stimulus_tasks_finished_batch(fin)
+        state.stimulus_tasks_erred_batch(err)
+        # the replica-release plane (AMM drops): the removal mutates
+        # state OUTSIDE the engine and is journaled as its own op,
+        # followed by the engine round it recommended
+        rel_key, rel_addr = fin[0][0], fin[0][1]
+        recs = state.stimulus_release_worker_data(
+            rel_key, rel_addr, "mx-rwd"
+        )
+        if recs:
+            state.transitions(recs, "mx-rwd")
+        # a bare recommendation round (the release plane)
+        state.transitions({procs[5][0]: "released"}, "mx-rel")
+        return state
+
+    rec = _build_trace_state()
+    mark = len(rec.transition_log)
+    drive(rec)
+    ops = [r["op"] for r in rec.trace.journal]
+    assert "task-finished" in ops and "task-erred" in ops
+    assert "release-worker-data" in ops and "transitions" in ops
+
+    rep = _build_trace_state()
+    mark_b = len(rep.transition_log)
+    replay_stimulus_trace(rep, list(rec.trace.journal))
+    assert transition_stream(rec, mark) == transition_stream(rep, mark_b)
+    # the replayed removal really happened: replica sets agree
+    assert {
+        k: sorted(ws.address for ws in ts.who_has)
+        for k, ts in rec.tasks.items()
+    } == {
+        k: sorted(ws.address for ws in ts.who_has)
+        for k, ts in rep.tasks.items()
+    }
+    # a record whose digest field was DROPPED (not just stale) is an
+    # edit too — verification must refuse, not silently skip
+    import pytest
+
+    clipped = [dict(r) for r in rec.trace.journal]
+    clipped[1].pop("digest")
+    with pytest.raises(ValueError, match="missing"):
+        replay_stimulus_trace(_build_trace_state(), clipped)
+
+
+def test_flight_recorder_ring_and_sampling():
+    from distributed_tpu.tracing import FlightRecorder
+
+    tr = FlightRecorder(ring_size=8, enabled=True, sample=1,
+                        journal=False, journal_size=4)
+    for i in range(20):
+        tr.emit("engine", "e", f"s-{i}", n=i)
+    assert tr.total == 20
+    assert len(tr) == 8
+    tail = tr.tail()
+    assert [ev["n"] for ev in tail] == list(range(12, 20))
+    assert [ev["seq"] for ev in tail] == list(range(12, 20))
+    assert tr.tail(3)[0]["n"] == 17
+    # disabled recorder emits nothing; sampling keeps 1-in-N
+    off = FlightRecorder(ring_size=8, enabled=False)
+    off.emit("engine", "e", "s")
+    assert off.total == 0
+    sam = FlightRecorder(ring_size=64, enabled=True, sample=4)
+    for _ in range(40):
+        sam.emit_task("transition", "memory", "s")
+    assert sam.total == 10
+
+
+def test_perfetto_export_schema_and_cli(tmp_path):
+    """ACCEPTANCE (PR 6): the Perfetto export of a traced run is valid
+    Chrome trace_event JSON (schema-validated, no browser needed), via
+    both the API and the CLI."""
+    import subprocess
+    import sys as _sys
+
+    from distributed_tpu.diagnostics.flight_recorder import to_perfetto
+    from distributed_tpu.tracing import to_jsonl
+
+    state = _build_trace_state()
+    _flood_to_memory(state)
+    events = state.trace.tail()
+    assert events
+    doc = to_perfetto(events)
+    # trace_event JSON-object format contract
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["displayTimeUnit"] in ("ms", "ns")
+    cats = set()
+    for ev in doc["traceEvents"]:
+        assert set(ev) >= {"name", "ph", "ts", "pid", "tid"}, ev
+        assert ev["ph"] in ("i", "M", "X")
+        if ev["ph"] == "i":
+            assert isinstance(ev["ts"], float) and ev["ts"] >= 0
+            assert ev["s"] in ("t", "p", "g")
+            cats.add(ev["cat"])
+    # a bare SchedulerState run has no server, so only the engine-side
+    # categories appear here; ingress/egress tracks are asserted on the
+    # live cluster in test_trace_endpoint_and_histograms_live
+    assert {"engine", "transition"} <= cats
+    json.dumps(doc)  # round-trippable
+
+    # CLI: JSONL file in, perfetto JSON out
+    src = tmp_path / "trace.jsonl"
+    src.write_text(to_jsonl(events))
+    out = tmp_path / "out.json"
+    proc = subprocess.run(
+        [_sys.executable, "-m",
+         "distributed_tpu.diagnostics.flight_recorder",
+         "--input", str(src), "--perfetto", str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc2 = json.loads(out.read_text())
+    assert len(doc2["traceEvents"]) == len(doc["traceEvents"])
+    # a newer schema major is refused, not mis-rendered
+    import pytest
+
+    with pytest.raises(ValueError, match="schema"):
+        to_perfetto([{"v": 99, "cat": "engine", "ts": 0.0}])
+
+
+@gen_test()
+async def test_trace_endpoint_and_histograms_live():
+    """/trace on both roles serves the schema-versioned JSONL tail, one
+    stimulus id joins ingress -> engine -> egress across it, and the
+    engine/egress histograms appear on /metrics with observations."""
+    from distributed_tpu.tracing import from_jsonl
+
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            futs = c.map(lambda x: x + 3, range(12), pure=False)
+            await c.gather(futs)
+            sport = cluster.scheduler.http_server.port
+            status, body = await http_get(sport, "/trace")
+            assert status == 200
+            events = from_jsonl(body)
+            assert events and all(ev["v"] == 1 for ev in events)
+            by_cat = {}
+            for ev in events:
+                by_cat.setdefault(ev["cat"], []).append(ev)
+            assert by_cat.get("ingress") and by_cat.get("engine")
+            assert by_cat.get("egress") and by_cat.get("transition")
+            # causal join: some task-finished stimulus appears at
+            # ingress AND in the engine pass it folded into
+            fin_stims = {
+                ev["stim"] for ev in by_cat["ingress"]
+                if ev["name"] == "task-finished"
+            }
+            assert fin_stims & {
+                ev["stim"]
+                for ev in by_cat["engine"] + by_cat["transition"]
+            }
+            # the update-graph ingress joins the compute-task egress
+            ug = [ev for ev in by_cat["ingress"]
+                  if ev["name"] == "update-graph"]
+            assert ug and any(
+                ev["stim"] == ug[-1]["stim"] for ev in by_cat["egress"]
+            )
+            # worker role serves its own stimulus timeline
+            wport = cluster.workers[0].http_server.port
+            status, body = await http_get(wport, "/trace")
+            assert status == 200
+            wevents = from_jsonl(body)
+            assert wevents and all(
+                ev["cat"] == "wstim" for ev in wevents
+            )
+            assert any(ev["name"] == "ComputeTaskEvent" for ev in wevents)
+            # histograms made it to /metrics with real observations
+            status, body = await http_get(sport, "/metrics")
+            text = body.decode()
+            for needle in (
+                'dtpu_engine_pass_seconds_bucket{le="+Inf"}',
+                "dtpu_engine_transition_batch_size_count",
+                "dtpu_egress_envelope_msgs_sum",
+                "dtpu_trace_events_total",
+            ):
+                assert needle in text, needle
+            count = [
+                ln for ln in text.splitlines()
+                if ln.startswith("dtpu_engine_pass_seconds_count")
+            ][0]
+            assert float(count.split()[-1]) > 0
+
+
 def test_rate_limiter_filter():
     import logging
 
@@ -661,9 +970,9 @@ async def test_computations_resubmission_does_not_duplicate():
 
 def test_metrics_names_unique_and_documented():
     """Every `dtpu_*` line each exposition emits must be unique (no
-    duplicate samples, Prometheus rejects them) and documented in
-    docs/wire.md / docs/scheduler_coprocessor.md — so the metric surface
-    cannot drift away from its documentation."""
+    duplicate samples, Prometheus rejects them) and documented in the
+    consolidated docs/observability.md metric table — so the metric
+    surface cannot drift away from its documentation."""
     from pathlib import Path
 
     from distributed_tpu.http.server import scheduler_metrics, worker_metrics
@@ -690,10 +999,7 @@ def test_metrics_names_unique_and_documented():
         get_data_wire_bytes = 0
 
     repo = Path(__file__).resolve().parent.parent
-    docs = "".join(
-        (repo / doc).read_text()
-        for doc in ("docs/wire.md", "docs/scheduler_coprocessor.md")
-    )
+    docs = (repo / "docs/observability.md").read_text()
 
     all_names: set[str] = set()
     for blob in (scheduler_metrics(_Sched()), worker_metrics(_Worker())):
@@ -716,12 +1022,21 @@ def test_metrics_names_unique_and_documented():
             seen_samples.add(sample)
             all_names.add(name)
 
-    # the full surface must be present in this test's expositions
+    # the full surface must be present in this test's expositions —
+    # including the engine/egress histogram families and the
+    # flight-recorder gauges (PR 6)
     assert {"dtpu_scheduler_tasks", "dtpu_worker_tasks_executing",
             "dtpu_wire_pool_bytes", "dtpu_stealing_moves_total",
-            "dtpu_worker_spill_count_total"} <= all_names
+            "dtpu_worker_spill_count_total",
+            "dtpu_engine_transition_batch_size_bucket",
+            "dtpu_engine_transition_batch_size_sum",
+            "dtpu_engine_transition_batch_size_count",
+            "dtpu_engine_pass_seconds_bucket",
+            "dtpu_egress_envelope_msgs_bucket",
+            "dtpu_trace_events_total",
+            "dtpu_trace_ring_events"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
-        f"metrics missing from docs/wire.md / docs/scheduler_coprocessor.md: "
+        f"metrics missing from the docs/observability.md table: "
         f"{undocumented}"
     )

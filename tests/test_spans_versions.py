@@ -220,3 +220,11 @@ async def test_span_tree_cumulative_aggregation():
             d = flow.to_dict()
             assert d["cumulative"]["n_tasks"] == 12
             assert d["children"][0]["cumulative"]["n_tasks"] == 7
+            # spans carry the stimulus ids of the transitions that fed
+            # them — the causal join key against /trace (PR 6)
+            assert inner.recent_stimuli
+            trace_stims = {
+                ev["stim"] for ev in cluster.scheduler.trace.tail()
+            }
+            assert set(inner.recent_stimuli) <= trace_stims
+            assert d["children"][0]["recent_stimuli"]
