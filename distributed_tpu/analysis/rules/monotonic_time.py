@@ -57,6 +57,10 @@ class MonotonicTimeRule(Rule):
         "distributed_tpu/coordination/**",
         "distributed_tpu/protocol/**",
         "distributed_tpu/tracing.py",
+        # telemetry snapshots/timestamps share the flight recorder's
+        # monotonic clock — an NTP step must never skew a bandwidth
+        # sample or misalign /telemetry records against /trace
+        "distributed_tpu/telemetry.py",
     )
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:

@@ -117,6 +117,19 @@ defaults: dict[str, Any] = {
             "journal": False,
             "journal-size": 65536,    # stimulus records kept in record mode
         },
+        # measured-truth telemetry plane (telemetry.py;
+        # docs/observability.md): per-link transfer EWMAs/t-digests,
+        # task-prefix priors, and the shadow cost-model divergence
+        # monitor.  Read-only: decisions still use the constants above
+        # (ROADMAP item 3 swaps the inputs in a future PR).
+        "telemetry": {
+            "enabled": True,
+            "ewma-alpha": 0.25,       # per-sample EWMA decay
+            # 1-in-N sampling of shadow cost evaluations (placement +
+            # steal pricing); the divergence histogram observes only
+            # sampled evals
+            "divergence-sample": 1,
+        },
         "active-memory-manager": {
             "start": True,
             "interval": "2s",

@@ -41,6 +41,9 @@ class DumpArtefact:
             for addr, evs in (self.state.get("worker_traces") or {}).items()
             if isinstance(evs, list)
         }
+        # measured-truth telemetry snapshot (telemetry.py): per-link
+        # EWMAs/quantiles, priors, RTTs, divergence summary
+        self.telemetry: list = list(sched.get("telemetry") or [])
 
     @classmethod
     def from_file(cls, path: str) -> "DumpArtefact":
@@ -115,6 +118,17 @@ class DumpArtefact:
             ev for ev in events
             if (cat is None or ev.get("cat") == cat)
             and (stim is None or ev.get("stim") == stim)
+        ]
+
+    def telemetry_records(self, type_: str | None = None) -> list[dict]:
+        """Telemetry snapshot records from the dump, optionally filtered
+        by ``type`` (``link`` / ``prior`` / ``rtt`` / ``divergence``):
+        the post-mortem twin of the live ``/telemetry`` route — e.g.
+        which links' measured bandwidth the cost-model constant was
+        lying about when the cluster was dumped."""
+        return [
+            rec for rec in self.telemetry
+            if type_ is None or rec.get("type") == type_
         ]
 
     def workers_summary(self) -> dict[str, dict]:

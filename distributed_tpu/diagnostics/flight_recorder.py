@@ -53,15 +53,27 @@ _TRACKS = {
     "kernel": (4, "kernels (device co-processor)"),
     "egress": (5, "egress (coalesced envelopes)"),
     "wstim": (6, "worker stimuli"),
+    "shadow": (7, "shadow cost model (divergence samples)"),
 }
 _OTHER_TRACK = (9, "other")
 
 
-def to_perfetto(events: Iterable[dict]) -> dict:
+def to_perfetto(events: Iterable[dict],
+                telemetry: Iterable[dict] | None = None) -> dict:
     """Chrome ``trace_event`` JSON (the "JSON Array Format" with
     metadata) from flight-recorder events.  Timestamps are the ring's
     monotonic seconds scaled to microseconds — absolute values are
-    meaningless across processes, deltas and ordering are exact."""
+    meaningless across processes, deltas and ordering are exact.
+
+    ``telemetry`` (optional) takes ``/telemetry`` JSONL records —
+    snapshots of the measured-truth plane (telemetry.py), each stamped
+    with the same monotonic clock — and renders them as Perfetto
+    COUNTER tracks: per-link EWMA bandwidth and the prior durations
+    plot on the same timeline as the stimulus events.  Sampled
+    ``shadow`` ring events additionally feed a "costmodel divergence
+    ratio" counter track (their ``n`` is the ratio in permille), so
+    the decisions the constants are lying about are visible as spikes
+    next to the engine passes that made them."""
     events = list(events)
     for ev in events:
         v = ev.get("v", TRACE_SCHEMA_VERSION)
@@ -106,6 +118,60 @@ def to_perfetto(events: Iterable[dict]) -> dict:
                 },
             }
         )
+        if cat == "shadow":
+            # divergence counter: one sample per shadow event, value =
+            # measured/constant ratio (n is permille)
+            trace_events.append(
+                {
+                    "name": "costmodel divergence ratio",
+                    "ph": "C",
+                    "ts": float(ev.get("ts", 0.0)) * 1e6,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"ratio": float(ev.get("n", 0)) / 1000.0},
+                }
+            )
+    for rec in telemetry or ():
+        ts = float(rec.get("ts", 0.0)) * 1e6
+        kind = rec.get("type")
+        if kind == "link":
+            trace_events.append(
+                {
+                    "name": (
+                        f"link {rec.get('src', '?')} -> "
+                        f"{rec.get('dst', '?')} MB/s"
+                    ),
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {
+                        "MB/s": float(rec.get("bandwidth", 0.0)) / 2**20
+                    },
+                }
+            )
+        elif kind == "prior":
+            trace_events.append(
+                {
+                    "name": f"prior {rec.get('prefix', '?')} seconds",
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"seconds": float(rec.get("duration", 0.0))},
+                }
+            )
+        elif kind == "rtt":
+            trace_events.append(
+                {
+                    "name": f"rtt {rec.get('worker', '?')} ms",
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"ms": float(rec.get("rtt", 0.0)) * 1e3},
+                }
+            )
     return {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
@@ -302,6 +368,13 @@ def main(argv: list[str] | None = None) -> int:
              "(open in chrome://tracing or ui.perfetto.dev)",
     )
     parser.add_argument(
+        "--telemetry", metavar="SRC",
+        help="also render /telemetry JSONL records (file path or "
+             "http URL) as Perfetto counter tracks: per-link measured "
+             "MB/s, prior durations, heartbeat RTTs next to the "
+             "stimulus timeline",
+    )
+    parser.add_argument(
         "--jsonl", metavar="OUT",
         help="re-emit the (possibly url-fetched) events as JSONL to OUT",
     )
@@ -315,11 +388,18 @@ def main(argv: list[str] | None = None) -> int:
     else:
         text = sys.stdin.read()
     events = from_jsonl(text)
+    telemetry = None
+    if args.telemetry:
+        if args.telemetry.startswith(("http://", "https://")):
+            telemetry = from_jsonl(_fetch_url(args.telemetry))
+        else:
+            with open(args.telemetry) as f:
+                telemetry = from_jsonl(f.read())
 
     wrote = False
     if args.perfetto:
         with open(args.perfetto, "w") as f:
-            json.dump(to_perfetto(events), f)
+            json.dump(to_perfetto(events, telemetry=telemetry), f)
         print(f"wrote {len(events)} events to {args.perfetto}")
         wrote = True
     if args.jsonl:

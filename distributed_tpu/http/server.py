@@ -185,6 +185,157 @@ def trace_metric_lines(trace: Any) -> list[str]:
     ]
 
 
+#: exposition cap on per-link label pairs — links are O(workers^2) and
+#: a big fleet must not turn /metrics into megabytes; the top spenders
+#: by moved bytes are the ones a cost-model investigation wants
+TELEMETRY_MAX_LINKS = 64
+
+
+def telemetry_metric_lines(tel: Any) -> list[str]:
+    """``dtpu_link_*`` exposition shared by both roles: the measured-
+    truth per-link transfer stats (telemetry.py).  On the scheduler
+    ``tel`` is the fleet aggregate; on a worker it is that node's own
+    collector."""
+    import heapq
+
+    # nlargest, not a full sort: links are O(workers^2) and this runs
+    # on the event loop at every Prometheus scrape
+    links = heapq.nlargest(
+        TELEMETRY_MAX_LINKS, tel.links.values(),
+        key=lambda ln: ln.bytes_total,
+    )
+    lines = []
+    for name, attr, help_, type_ in (
+        ("bandwidth_bytes_per_second", "bandwidth",
+         "Measured per-link transfer bandwidth (EWMA, dst-observed)",
+         "gauge"),
+        ("latency_seconds", "latency",
+         "Measured per-link residual latency (EWMA, dst-observed)",
+         "gauge"),
+    ):
+        first = True
+        for link in links:
+            lines.append(
+                prom_line(
+                    f"dtpu_link_{name}",
+                    getattr(link, attr).value,
+                    {"src": link.src, "dst": link.dst},
+                    help_=help_ if first else None, type_=type_,
+                )
+            )
+            first = False
+    first = True
+    for link in links:
+        lines.append(
+            prom_line(
+                "dtpu_link_transfer_bytes_total", link.bytes_total,
+                {"src": link.src, "dst": link.dst},
+                help_="Payload bytes moved per link (dst-observed)"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    first = True
+    for link in links:
+        lines.append(
+            prom_line(
+                "dtpu_link_samples_total", link.bandwidth.count,
+                {"src": link.src, "dst": link.dst},
+                help_="Transfer samples folded per link (dst-observed)"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    first = True
+    for link in links:
+        if not link.peer_count:
+            continue
+        lines.append(
+            prom_line(
+                "dtpu_link_served_wire_bytes_total", link.peer_bytes,
+                {"src": link.src, "dst": link.dst},
+                help_="True wire bytes the serving end reported per link "
+                      "(the framing-overhead cross-check)"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    return lines
+
+
+def cluster_telemetry_metric_lines(tel: Any) -> list[str]:
+    """Scheduler-only telemetry exposition: heartbeat RTTs, task-prefix
+    priors, and the shadow cost-model divergence monitor
+    (telemetry.py; docs/observability.md)."""
+    lines = telemetry_metric_lines(tel)
+    first = True
+    for worker, rtt in sorted(tel.rtt.items()):
+        lines.append(
+            prom_line(
+                "dtpu_link_heartbeat_rtt_seconds", rtt,
+                {"worker": worker},
+                help_="Scheduler<->worker heartbeat round trip "
+                      "(worker-measured EWMA, monotonic stamps)"
+                if first else None,
+                type_="gauge",
+            )
+        )
+        first = False
+    for name, attr, help_ in (
+        ("dtpu_prior_duration_seconds", "duration",
+         "Measured per-prefix task duration (EWMA)"),
+        ("dtpu_prior_nbytes", "nbytes",
+         "Measured per-prefix output bytes (EWMA)"),
+    ):
+        first = True
+        for prefix, prior in sorted(tel.priors.items()):
+            lines.append(
+                prom_line(
+                    name, getattr(prior, attr).value, {"prefix": prefix},
+                    help_=help_ if first else None, type_="gauge",
+                )
+            )
+            first = False
+    first = True
+    for prefix, prior in sorted(tel.priors.items()):
+        lines.append(
+            prom_line(
+                "dtpu_prior_tasks_total", prior.n_tasks,
+                {"prefix": prefix},
+                help_="Executions folded into the prefix priors"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    lines.extend(
+        prom_histogram_lines(
+            "dtpu_costmodel_divergence_ratio", tel.hist_divergence,
+            help_="Shadow cost model: measured/constant comm-cost ratio "
+                  "per sampled placement/steal decision",
+        )
+    )
+    lines.append(
+        prom_line(
+            "dtpu_costmodel_shadow_evals_total", tel.shadow_evals,
+            help_="Shadow cost-model evaluations performed",
+            type_="counter",
+        )
+    )
+    lines.append(
+        prom_line(
+            "dtpu_costmodel_shadow_measured_total", tel.shadow_measured,
+            help_="Shadow evaluations where a measured link priced a "
+                  "dependency",
+            type_="counter",
+        )
+    )
+    return lines
+
+
 def wire_metric_lines() -> list[str]:
     """``dtpu_wire_*`` exposition shared by every server role: the
     zero-copy data plane counters (protocol/buffers.py).  A production
@@ -299,6 +450,7 @@ def scheduler_metrics(scheduler: Any) -> bytes:
          "Messages folded per coalesced worker-stream envelope"),
     ):
         lines.extend(prom_histogram_lines(name, hist, help_=help_))
+    lines.extend(cluster_telemetry_metric_lines(s.telemetry))
     lines.extend(trace_metric_lines(s.trace))
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()
@@ -327,6 +479,7 @@ def worker_metrics(worker: Any) -> bytes:
                       type_="counter")
         )
         lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
+    lines.extend(telemetry_metric_lines(worker.telemetry))
     lines.extend(trace_metric_lines(st.trace))
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -142,6 +142,7 @@ class Scheduler(Server):
             "unregister_nanny_plugin": self.unregister_nanny_plugin,
             "unregister_worker_plugin": self.unregister_worker_plugin,
             "get_cluster_state": self.get_cluster_state,
+            "get_telemetry": self.get_telemetry,
             "get_runspec": self.get_runspec,
             "versions": self.versions,
             "worker_versions": self.worker_versions,
@@ -289,6 +290,13 @@ class Scheduler(Server):
                     # (docs/observability.md; schema-versioned records)
                     "/trace": lambda: (
                         to_jsonl(self.trace.tail()),
+                        "application/x-ndjson",
+                    ),
+                    # fleet telemetry snapshot: per-link EWMAs +
+                    # t-digest quantiles, prefix priors, heartbeat
+                    # RTTs, divergence summary (telemetry.py)
+                    "/telemetry": lambda: (
+                        to_jsonl(self.state.telemetry.snapshot()),
                         "application/x-ndjson",
                     ),
                     **json_api_routes(self),
@@ -583,7 +591,8 @@ class Scheduler(Server):
     async def heartbeat_worker(
         self, address: str = "", now: float = 0.0, metrics: dict | None = None,
         fine_metrics: list | None = None, executing_status: str = "",
-        status_seq: int = -1, **kwargs: Any,
+        status_seq: int = -1, link_telemetry: list | None = None,
+        rtt: float = 0.0, **kwargs: Any,
     ) -> dict:
         ws = self.state.workers.get(address)
         if ws is None:
@@ -594,6 +603,18 @@ class Scheduler(Server):
             ws.metrics = metrics
         if fine_metrics and self.spans is not None:
             self.spans.collect_fine_metrics(fine_metrics)
+        # measured-truth telemetry plane (telemetry.py): per-link
+        # transfer deltas + the worker-measured heartbeat RTT fold into
+        # the fleet aggregate, and the same fine-metric stream feeds the
+        # per-prefix priors
+        tel = self.state.telemetry
+        if tel.enabled:
+            if link_telemetry:
+                tel.fold_rows(link_telemetry, reporter=address)
+            if rtt:
+                tel.record_rtt(address, rtt)
+            if fine_metrics:
+                tel.fold_fine_rows(fine_metrics)
         # reconcile pause state: the event message can be lost at
         # startup (see Worker.heartbeat) and a stale "running" view
         # pins the paused worker's tasks out of stealing forever.
@@ -1737,6 +1758,11 @@ class Scheduler(Server):
             "deps": [d.key for d in ts.dependencies],
         }
 
+    async def get_telemetry(self) -> list[dict]:
+        """The fleet telemetry snapshot (JSON-safe records): the RPC
+        twin of the HTTP ``/telemetry`` route (telemetry.py)."""
+        return self.state.telemetry.snapshot()
+
     async def get_cluster_state(self, exclude: list[str] | None = None) -> dict:
         """Debug dump of the whole cluster (reference scheduler.py:3964)."""
         s = self.state
@@ -1772,6 +1798,11 @@ class Scheduler(Server):
             "events": {t: len(evs) for t, evs in s.events.items()},
             "transition_log_length": len(s.transition_log),
         }
+        if "telemetry" not in (exclude or ()):
+            # the measured-truth snapshot travels with the dump: a
+            # post-mortem can see which links/priors the cost model was
+            # lying about without a live cluster (telemetry.py)
+            scheduler_info["telemetry"] = self.state.telemetry.snapshot()
         if "transition_log" not in (exclude or ()):
             # the newest transition rows travel WITH the dump so a
             # post-mortem can replay a task's story offline

@@ -44,6 +44,7 @@ from distributed_tpu.exceptions import (
 )
 from distributed_tpu.graph.spec import TaskSpec
 from distributed_tpu.protocol.serialize import compact_frames, wrap_opaque
+from distributed_tpu.telemetry import ClusterTelemetry
 from distributed_tpu.tracing import (
     SECONDS_BUCKETS,
     SIZE_BUCKETS,
@@ -441,6 +442,13 @@ class SchedulerState:
         # messages folded per coalesced egress envelope (server-side
         # observe site: Scheduler.stream_payload_flush)
         self.hist_egress = Histogram(SIZE_BUCKETS)
+        # measured-truth telemetry plane (telemetry.py): fleet link
+        # EWMAs/t-digests folded from worker heartbeats, task-prefix
+        # priors, and the shadow cost-model divergence monitor.
+        # STRICTLY read-only: no decision path consults it (property-
+        # tested in tests/test_telemetry.py); ROADMAP item 3 swaps the
+        # kernel inputs in a future PR.
+        self.telemetry = ClusterTelemetry()
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
         # one entry per update_graph batch (reference scheduler.py:864)
@@ -1478,6 +1486,9 @@ class SchedulerState:
             assert ws in self.running, (ws, ts)
         duration = self.get_task_duration(ts)
         comm = self.get_comm_cost(ts, ws)
+        # shadow divergence monitor (read-only): this is THE placement
+        # decision — record what the measured model would have priced
+        self.shadow_comm_cost(ts, ws, comm, "placement", stimulus_id)
         ws.processing[ts] = duration + comm
         ts.processing_on = ws
         ts.state = "processing"
@@ -1544,6 +1555,82 @@ class SchedulerState:
             ]
         nbytes = sum(dts.get_nbytes() for dts in deps)
         return nbytes / self.bandwidth + len(deps) * self.transfer_latency
+
+    def get_comm_cost_measured(
+        self, ts: TaskState, ws: WorkerState
+    ) -> tuple[float, bool]:
+        """The measured-model twin of :meth:`get_comm_cost` — same
+        shape (missing-dep bytes over bandwidth plus a per-dep fixed
+        cost) with per-link MEASURED inputs where the telemetry plane
+        has them (telemetry.py):
+
+        - bandwidth: the best (highest-EWMA) measured link from any of
+          the dep's holders to ``ws`` — the optimistic achievable
+          fetch, matching gather's freedom to pick any holder;
+        - fixed cost: that link's residual-latency EWMA, else the
+          worker's heartbeat-RTT EWMA, else ``transfer_latency``;
+        - constant fallback for links never observed.
+
+        Returns ``(cost, used_measured)`` — the flag marks whether any
+        measured link actually priced a dep (a pure-fallback cost says
+        nothing about the constants).  READ-ONLY shadow: no decision
+        path consults this (ROADMAP item 3 swaps the inputs later).
+        """
+        tel = self.telemetry
+        rtt = tel.rtt.get(ws.address, 0.0)
+        total = 0.0
+        used_measured = False
+        for dts in ts.dependencies:
+            if ws in dts.who_has:
+                continue
+            nb = dts.get_nbytes()
+            best_bw = 0.0
+            best_lat = -1.0
+            for hws in dts.who_has:
+                link = tel.links.get((hws.address, ws.address))
+                if link is not None and link.bandwidth.count:
+                    bw = link.bandwidth.value
+                    if bw > best_bw:
+                        best_bw = bw
+                        best_lat = link.latency.value
+            if best_bw > 0.0:
+                used_measured = True
+                total += nb / best_bw + best_lat
+            elif rtt > 0.0:
+                # unseen link, but the fleet's control-plane RTT is
+                # measured: constant bandwidth + measured fixed cost
+                used_measured = True
+                total += nb / self.bandwidth + rtt
+            else:
+                total += nb / self.bandwidth + self.transfer_latency
+        return total, used_measured
+
+    def shadow_comm_cost(self, ts: TaskState, ws: WorkerState,
+                         constant: float | None, site: str,
+                         stimulus_id: str) -> None:
+        """Shadow cost-model divergence monitor: next to a decision that
+        just priced ``ts`` on ``ws`` with the CONSTANT model, compute
+        the measured model and record ``measured / constant`` in the
+        ``dtpu_costmodel_divergence_ratio`` histogram plus a sampled
+        flight-recorder ``shadow`` event carrying the stimulus id — so
+        Perfetto shows which decisions the constants are lying about.
+        Zero behavior change: callers already made their decision.
+
+        Pass ``constant=None`` from callers that did NOT already
+        compute the constant cost for their own use — it is then
+        computed here, BEHIND the enabled/sampling gates, so a
+        disabled or sampled-out eval costs two attribute reads."""
+        tel = self.telemetry
+        if not tel.enabled or not tel.tick_divergence():
+            return
+        if constant is None:
+            constant = self.get_comm_cost(ts, ws)
+        measured, used_measured = self.get_comm_cost_measured(ts, ws)
+        ratio = tel.observe_divergence(constant, measured, used_measured)
+        self.trace.emit_task(
+            "shadow", site, stimulus_id, key=ts.key,
+            n=int(ratio * 1000), dest=ws.address,
+        )
 
     def worker_objective(self, ts: TaskState, ws: WorkerState) -> tuple:
         """Lower is better (reference scheduler.py:3131 — plus a fixed
@@ -2395,6 +2482,7 @@ class SchedulerState:
             return {}, {}
         del self.workers[address]
         self.aliases.pop(ws.name, None)
+        self.telemetry.forget_worker(address)
         ws.status = WORKER_STATUS_CLOSED
         self.running.discard(ws)
         self.idle.pop(ws.address, None)

@@ -202,9 +202,13 @@ class WorkStealing:
             return
         stimulus_id = seq_name("steal")
         victim_duration = victim.processing.get(ts, 0.0)
-        thief_duration = self.state.get_task_duration(
-            ts
-        ) + self.state.get_comm_cost(ts, thief)
+        comm_cost = self.state.get_comm_cost(ts, thief)
+        # shadow divergence monitor (read-only): this steal was priced
+        # with the constant model — record the measured twin under the
+        # move's stimulus id (telemetry.py; docs/observability.md)
+        self.state.shadow_comm_cost(ts, thief, comm_cost, "steal",
+                                    stimulus_id)
+        thief_duration = self.state.get_task_duration(ts) + comm_cost
         self.remove_key_from_stealable(ts)
         self.in_flight[key] = InFlightInfo(
             victim, thief, victim_duration, thief_duration, stimulus_id
@@ -243,6 +247,10 @@ class WorkStealing:
             # dead thief: leave the task in stealable for the next cycle
             return
         stimulus_id = seq_name("steal-spec")
+        # same shadow hop as the confirm path: the criterion priced this
+        # move with the constant model just before calling here
+        # (constant=None: recomputed only behind the sampling gate)
+        self.state.shadow_comm_cost(ts, thief, None, "steal", stimulus_id)
         self.remove_key_from_stealable(ts)
         self.state._exit_processing_common(ts)
         ts.state = "waiting"  # transient; re-enter processing on thief

@@ -55,6 +55,8 @@ CATEGORIES = (
     "kernel",      # a device co-processor cycle (placement/steal/AMM/mirror)
     "egress",      # a coalesced envelope left on a batched stream
     "wstim",       # a worker state-machine stimulus (task-level, sampled)
+    "shadow",      # a shadow cost-model divergence sample (task-level,
+                   # sampled; telemetry.py — n = ratio in permille)
 )
 
 

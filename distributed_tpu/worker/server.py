@@ -205,6 +205,17 @@ class Worker(Server):
         from distributed_tpu.worker.metrics import FineMetrics
 
         self.fine_metrics = FineMetrics()
+        # measured-truth transfer telemetry (telemetry.py): both ends of
+        # every get_data/gather_dep transfer file (src, dst, nbytes,
+        # seconds) here; heartbeats ship the since-last delta to the
+        # scheduler's fleet aggregate (docs/observability.md)
+        from distributed_tpu.telemetry import EWMA, LinkTelemetry
+
+        self.telemetry = LinkTelemetry()
+        # heartbeat round-trip EWMA, measured with monotonic stamps
+        # around the heartbeat RPC; shipped on the NEXT heartbeat and
+        # exposed as dtpu_link_heartbeat_rtt_seconds scheduler-side
+        self._hb_rtt = EWMA(self.telemetry.alpha)
 
         handlers = {
             "get_data": self.get_data,
@@ -222,6 +233,7 @@ class Worker(Server):
             "terminate": self.close_rpc,
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
+            "get_telemetry": self.get_telemetry,
         }
         stream_handlers = {
             "compute-task": self._stream_compute_task,
@@ -350,6 +362,13 @@ class Worker(Server):
                         to_jsonl(self.trace.tail()),
                         "application/x-ndjson",
                     ),
+                    # measured-truth telemetry snapshot: this node's
+                    # per-link EWMAs + t-digest quantiles as JSONL
+                    # (telemetry.py; docs/observability.md)
+                    "/telemetry": lambda: (
+                        to_jsonl(self.telemetry.snapshot()),
+                        "application/x-ndjson",
+                    ),
                 },
                 port=self._http_port,
             )
@@ -437,12 +456,20 @@ class Worker(Server):
         if self.batched_stream.closed():
             return
         delta = self.fine_metrics.take()
+        link_delta = self.telemetry.take()
+        t0 = time()
         try:
             resp = await self.rpc(self.scheduler_addr).heartbeat_worker(
                 address=self.address,
                 now=time(),
                 metrics=self.metrics(),
                 fine_metrics=self.fine_metrics.rows(delta),
+                link_telemetry=self.telemetry.rows(link_delta),
+                # last-known round-trip EWMA: the CURRENT trip's rtt is
+                # only known after this call returns, so each heartbeat
+                # carries the previous measurement (0.0 until the
+                # second heartbeat; the scheduler skips zeros)
+                rtt=self._hb_rtt.value if self._hb_rtt.count else 0.0,
                 # paused/running travels with every heartbeat: the
                 # event-driven worker-status-change message is lossy at
                 # the edges (a pause during startup fires before the
@@ -453,12 +480,14 @@ class Worker(Server):
                 else "running",
                 status_seq=self._status_seq,
             )
+            self._hb_rtt.update(time() - t0)
             if resp.get("status") == "missing":
                 # scheduler forgot us (e.g. after its restart): re-register
                 await self.close()
         except (CommClosedError, OSError):
             # don't lose the activity samples to a transient blip
             self.fine_metrics.restore(delta)
+            self.telemetry.restore(link_delta)
 
     def data_store_summary(self) -> dict:
         """One source of truth for the data-store/spill snapshot
@@ -574,12 +603,33 @@ class Worker(Server):
                 # comm.write returns true wire bytes (post-compression,
                 # incl. framing): the gap between this and the nbytes
                 # sum above is the zero-copy data plane's effectiveness
-                self.get_data_wire_bytes += await comm.write(
+                wire_bytes = await comm.write(
                     {"status": "OK", "data": data, "nbytes": nbytes}
                 )
+                self.get_data_wire_bytes += wire_bytes
+                # serving-end link sample: true wire bytes attributed to
+                # (us -> requester), as the peer CROSS-CHECK only — this
+                # clock stops when comm.write returns (OS buffer), not
+                # when the peer received the bytes, so it must never
+                # fold into the dst-observed bandwidth EWMA.  The
+                # requesting end files the authoritative sample; the
+                # scheduler classifies the shipped rows by reporter
+                # (telemetry.py)
+                # `and data`: an empty OK reply (keys already released)
+                # files nothing on the requesting end either, so the
+                # two ends' per-link sample counts stay in lockstep
+                if who and data:
+                    self.telemetry.record_peer(
+                        self.address, who, wire_bytes, time() - t0
+                    )
             return Status.dont_reply
         finally:
             self._outgoing_serves -= 1
+
+    async def get_telemetry(self) -> list[dict]:
+        """This node's telemetry snapshot (JSON-safe records): the RPC
+        twin of the HTTP ``/telemetry`` route (telemetry.py)."""
+        return self.telemetry.snapshot()
 
     async def gather(self, who_has: dict[Key, list[str]] | None = None) -> dict:
         """Pull keys from peers into local memory (reference worker.py:1274)."""
@@ -968,6 +1018,22 @@ class Worker(Server):
         if unit == "seconds":
             self.digest_metric(f"{context}-{label}-seconds", value)
 
+    def _execute_fine_metrics(self, span_id: str | None, prefix: str,
+                              duration: float, nbytes: int) -> None:
+        """One successful execution's activity rows, shared by _execute
+        and _execute_batch: compute seconds (spans), plus the per-task
+        output-bytes and task-count samples the scheduler's telemetry
+        plane folds into per-prefix priors (telemetry.py
+        fold_fine_rows — count makes the heartbeat sums per-task
+        means)."""
+        self._fine_metric(
+            "execute", span_id, prefix, "compute", "seconds", duration
+        )
+        self._fine_metric(
+            "execute", span_id, prefix, "output", "bytes", float(nbytes)
+        )
+        self._fine_metric("execute", span_id, prefix, "count", "tasks", 1.0)
+
     def _note_inner_duration(self, prefix: str, dur: float) -> None:
         """EMA of the bare in-thread fn duration per prefix (the inline
         fast-path gate).  Called from executor threads and the loop; a
@@ -1104,13 +1170,13 @@ class Worker(Server):
             for key, sid, ts, kind, value, start, stop in results:
                 if kind == "ok":
                     self.digest_metric("compute-duration", stop - start)
-                    self._fine_metric(
-                        "execute", ts.span_id, key_split(key), "compute",
-                        "seconds", stop - start,
+                    out_nbytes = sizeof(value)
+                    self._execute_fine_metrics(
+                        ts.span_id, key_split(key), stop - start, out_nbytes
                     )
                     events.append(ExecuteSuccessEvent(
                         stimulus_id=sid, key=key, value=value,
-                        start=start, stop=stop, nbytes=sizeof(value),
+                        start=start, stop=stop, nbytes=out_nbytes,
                         type=type(value).__name__,
                     ))
                 elif kind == "resched":
@@ -1232,9 +1298,9 @@ class Worker(Server):
             self.digest_metric("compute-duration", stop - start)
             from distributed_tpu.utils.misc import key_split
 
-            self._fine_metric(
-                "execute", ts.span_id, key_split(key), "compute",
-                "seconds", stop - start,
+            out_nbytes = sizeof(value)
+            self._execute_fine_metrics(
+                ts.span_id, key_split(key), stop - start, out_nbytes
             )
             return ExecuteSuccessEvent(
                 stimulus_id=stimulus_id,
@@ -1242,7 +1308,7 @@ class Worker(Server):
                 value=value,
                 start=start,
                 stop=stop,
-                nbytes=sizeof(value),
+                nbytes=out_nbytes,
                 type=type(value).__name__,
             )
         except Reschedule:
@@ -1295,6 +1361,7 @@ class Worker(Server):
         )
         try:
             with ledger.activity():
+                net_t0 = time()
                 try:
                     with context_meter.meter("network"):
                         resp = await self.rpc(worker).get_data(
@@ -1317,6 +1384,18 @@ class Worker(Server):
                     return GatherDepBusyEvent(
                         stimulus_id=stimulus_id, worker=worker,
                         keys=tuple(to_gather),
+                    )
+                # requesting-end link sample (peer -> us): payload bytes
+                # as the SERVER sized them over the full fetch duration
+                # — the cost the constant model prices, measured.
+                # Failed/busy/empty fetches file nothing: no bytes moved
+                # (an OK reply whose keys were already released carries
+                # zero bytes, and a 0 B/s sample would poison the EWMA).
+                payload_nbytes = sum((resp.get("nbytes") or {}).values())
+                if payload_nbytes > 0:
+                    self.telemetry.record(
+                        worker, self.address, payload_nbytes,
+                        time() - net_t0,
                     )
                 with context_meter.meter("deserialize"):
                     data = {

@@ -804,7 +804,8 @@ def test_perfetto_export_schema_and_cli(tmp_path):
     cats = set()
     for ev in doc["traceEvents"]:
         assert set(ev) >= {"name", "ph", "ts", "pid", "tid"}, ev
-        assert ev["ph"] in ("i", "M", "X")
+        # "C" = counter samples (shadow divergence / telemetry tracks)
+        assert ev["ph"] in ("i", "M", "X", "C")
         if ev["ph"] == "i":
             assert isinstance(ev["ts"], float) and ev["ts"] >= 0
             assert ev["s"] in ("t", "p", "g")
@@ -979,6 +980,8 @@ def test_metrics_names_unique_and_documented():
     from distributed_tpu.scheduler.state import SchedulerState
     from distributed_tpu.worker.state_machine import WorkerState
 
+    from distributed_tpu.telemetry import LinkTelemetry
+
     class _Stealing:
         count = 3
 
@@ -988,6 +991,25 @@ def test_metrics_names_unique_and_documented():
 
     # one task so the labeled per-state samples are exercised
     _Sched.state.new_task("metrics-k", None)
+    # seed the telemetry plane so every dtpu_link_/dtpu_prior_/
+    # dtpu_costmodel_ family is exercised (the parity gate must cover
+    # the full measured-truth surface)
+    tel = _Sched.state.telemetry
+    tel.fold_rows(
+        [["tcp://pm:1", "tcp://pm:2", 1_000_000, 0.01, 2]],
+        reporter="tcp://pm:2",
+    )
+    tel.fold_rows(
+        [["tcp://pm:1", "tcp://pm:2", 1_100_000, 0.01, 2]],
+        reporter="tcp://pm:1",
+    )
+    tel.record_rtt("tcp://pm:2", 0.002)
+    tel.fold_fine_rows([
+        ["execute", "", "inc", "compute", "seconds", 0.5],
+        ["execute", "", "inc", "output", "bytes", 1000.0],
+        ["execute", "", "inc", "count", "tasks", 2],
+    ])
+    tel.observe_divergence(1.0, 0.1, True)
 
     class _SpillDict(dict):  # enables the spill metric lines
         spilled_count = 0
@@ -997,6 +1019,9 @@ def test_metrics_names_unique_and_documented():
         state = WorkerState(nthreads=1)
         data = _SpillDict()
         get_data_wire_bytes = 0
+        telemetry = LinkTelemetry()
+
+    _Worker.telemetry.record("tcp://pm:2", "tcp://pm:3", 1000, 0.001)
 
     repo = Path(__file__).resolve().parent.parent
     docs = (repo / "docs/observability.md").read_text()
@@ -1023,8 +1048,8 @@ def test_metrics_names_unique_and_documented():
             all_names.add(name)
 
     # the full surface must be present in this test's expositions —
-    # including the engine/egress histogram families and the
-    # flight-recorder gauges (PR 6)
+    # including the engine/egress histogram families, the flight-
+    # recorder gauges (PR 6), and the telemetry plane (PR 7)
     assert {"dtpu_scheduler_tasks", "dtpu_worker_tasks_executing",
             "dtpu_wire_pool_bytes", "dtpu_stealing_moves_total",
             "dtpu_worker_spill_count_total",
@@ -1034,7 +1059,21 @@ def test_metrics_names_unique_and_documented():
             "dtpu_engine_pass_seconds_bucket",
             "dtpu_egress_envelope_msgs_bucket",
             "dtpu_trace_events_total",
-            "dtpu_trace_ring_events"} <= all_names
+            "dtpu_trace_ring_events",
+            "dtpu_link_bandwidth_bytes_per_second",
+            "dtpu_link_latency_seconds",
+            "dtpu_link_transfer_bytes_total",
+            "dtpu_link_samples_total",
+            "dtpu_link_served_wire_bytes_total",
+            "dtpu_link_heartbeat_rtt_seconds",
+            "dtpu_prior_duration_seconds",
+            "dtpu_prior_nbytes",
+            "dtpu_prior_tasks_total",
+            "dtpu_costmodel_divergence_ratio_bucket",
+            "dtpu_costmodel_divergence_ratio_sum",
+            "dtpu_costmodel_divergence_ratio_count",
+            "dtpu_costmodel_shadow_evals_total",
+            "dtpu_costmodel_shadow_measured_total"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
         f"metrics missing from the docs/observability.md table: "
