@@ -102,6 +102,22 @@ defaults: dict[str, Any] = {
             # scratch (the oracle pack).  DTPU_MIRROR_CHECK=1 verifies
             # the mirror against that oracle on every view.
             "mirror": True,
+            # device-mesh sharding of the placement engine + fleet
+            # mirror (ops/leveled.place_graph_leveled_sharded,
+            # scheduler/mirror.sharded_device_view): one placement
+            # cycle runs as a single partitioned XLA program over N
+            # devices.  Off by default — a one-device host pays pure
+            # collective overhead; enable on multi-chip (or the
+            # 8-device CPU mesh in tests/bench).
+            "mesh": {
+                "enabled": False,
+                # devices to put in the mesh; 0 = all visible
+                "devices": 0,
+                # "auto" (near-square factoring, workers axis the
+                # smaller factor) or an explicit "TxW" layout, e.g.
+                # "4x2" (tasks x workers)
+                "layout": "auto",
+            },
         },
         # flight recorder (tracing.py; docs/observability.md): always-on
         # bounded ring of causal control-loop events.  Shared by both

@@ -439,6 +439,52 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                     type_="counter",
                 )
             )
+        # per-shard mirror upload counters (sharded_device_view, the
+        # mesh plan path): a fresh cycle must read 0 rows on EVERY
+        # shard; full packs only move on growth/mesh changes
+        ss = mirror.sharded_stats()
+        if ss["n_shards"]:
+            for name, help_ in (
+                ("rows_uploaded", "Mirror rows scattered to this shard"),
+                ("bytes_uploaded", "Mirror bytes scattered to this shard"),
+                ("full_packs", "Full fleet packs shipped to this shard"),
+            ):
+                lines.append(f"# HELP dtpu_mirror_shard_{name}_total {help_}")
+                lines.append(f"# TYPE dtpu_mirror_shard_{name}_total counter")
+                for shard_i, v in enumerate(ss[name]):
+                    lines.append(
+                        prom_line(
+                            f"dtpu_mirror_shard_{name}_total", v,
+                            {"shard": str(shard_i)},
+                        )
+                    )
+    # per-shard sharded-placement-engine telemetry (mesh plan path,
+    # scheduler/jax_placement.py -> SchedulerState.observe_engine_shards)
+    if getattr(s, "engine_shards", None):
+        lines.append(
+            "# HELP dtpu_engine_shard_kernel_ms Sharded placement kernel "
+            "completion ms per mesh shard (last plan)"
+        )
+        lines.append("# TYPE dtpu_engine_shard_kernel_ms gauge")
+        for shard_i, row in enumerate(s.engine_shards):
+            lines.append(
+                prom_line(
+                    "dtpu_engine_shard_kernel_ms", row["kernel_ms"],
+                    {"shard": str(shard_i)},
+                )
+            )
+        lines.append(
+            "# HELP dtpu_engine_shard_h2d_bytes_total Task-tile bytes "
+            "shipped to this mesh shard by the sharded engine"
+        )
+        lines.append("# TYPE dtpu_engine_shard_h2d_bytes_total counter")
+        for shard_i, row in enumerate(s.engine_shards):
+            lines.append(
+                prom_line(
+                    "dtpu_engine_shard_h2d_bytes_total", row["h2d_bytes"],
+                    {"shard": str(shard_i)},
+                )
+            )
     # batched-engine + egress-coalescer histograms (tracing.Histogram,
     # observed in scheduler/state.py and Scheduler.stream_payload_flush)
     for name, hist, help_ in (

@@ -552,19 +552,27 @@ def _compute_pad(T: int, runs, offsets) -> int:
     return pad
 
 
-def _plan_runs(offsets: np.ndarray) -> list[tuple[int, list[int]]]:
+def _plan_runs(
+    offsets: np.ndarray,
+    bucket_fn=None,
+    small: int = SMALL_WAVE,
+) -> list[tuple[int, list[int]]]:
     """Group consecutive same-bucket waves into fused runs:
-    [(F, [wave,...])].  Small waves share the SMALL_WAVE bucket; larger
+    [(F, [wave,...])].  Small waves share the ``small`` bucket; larger
     consecutive waves with the same power-of-two bucket fuse too — one
     fori_loop dispatch per group instead of one program per wave (the
-    separate-program overhead dominates mid-sized waves)."""
+    separate-program overhead dominates mid-sized waves).  The sharded
+    planner (:func:`_plan_runs_sharded`) reuses this loop with a
+    per-shard ``bucket_fn``."""
+    if bucket_fn is None:
+        bucket_fn = _bucket
     sizes = np.diff(offsets)
     runs: list[tuple[int, list[int]]] = []
     cur: list[int] = []
     cur_f = 0
     for w, f in enumerate(sizes):
-        b = max(_bucket(int(f)), 0)
-        target = SMALL_WAVE if b <= SMALL_WAVE else b
+        b = bucket_fn(int(f))
+        target = small if b <= small else b
         if cur and target == cur_f:
             cur.append(w)
             continue
@@ -692,7 +700,11 @@ class _RunState:
             self.nthreads, self.running, self.occ0,
             F=F, K=K, uniform=self.uniform, fmt=self.fmt,
         )
-        rows_done = int(packed.offsets[waves[-1] + 1])
+        self._maybe_segment(int(packed.offsets[waves[-1] + 1]), last)
+
+    def _maybe_segment(self, rows_done: int, last: bool) -> None:
+        """Fetch rows final after this dispatch behind the remaining
+        device work (shared by the single-device and sharded drivers)."""
         if rows_done - self.seg_from >= self.SEG_MIN or (
             last and rows_done > self.seg_from
         ):
@@ -742,6 +754,9 @@ def place_graph_streamed(
     chunk_rows: int = 131072,
     min_stream: int = 262144,
     timings: dict | None = None,
+    mesh=None,
+    fleet_dev=None,
+    stats: dict | None = None,
 ) -> tuple[PackedGraph, LeveledResult]:
     """Fused pack+place: the H2D wire overlaps the pack AND the compute.
 
@@ -773,6 +788,15 @@ def place_graph_streamed(
     Falls back to pack+place (same results, no overlap) when the native
     library is unavailable or the graph is under ``min_stream`` tasks.
 
+    With ``mesh`` (an engine mesh from ops/partition.make_engine_mesh)
+    the waves dispatch through the SHARDED engine instead: each fused
+    run's task tiles are placed with ``NamedSharding`` — per-shard H2D,
+    async against both the pack fill and earlier runs' compute — and
+    ``fleet_dev``/``stats`` pass through to
+    :func:`place_graph_leveled_sharded`.  The sharded wire is always
+    the exact f16 format (``compact`` is ignored: the u8 log-encode is
+    a tunneled single-device-wire optimization).
+
     Returns ``(packed, result)``; ``packed``'s host arrays are fully
     filled by return time.
     """
@@ -797,7 +821,14 @@ def place_graph_streamed(
             timings["topo_s"] = _time.perf_counter() - t0
             timings["fmt"] = "f16"
             timings["fallback"] = True
-        result = place_graph_leveled(packed, nthreads, occupancy0, running)
+        if mesh is not None:
+            result = place_graph_leveled_sharded(
+                mesh, packed, nthreads, occupancy0, running,
+                fleet_dev=fleet_dev, stats=stats,
+            )
+        else:
+            result = place_graph_leveled(packed, nthreads, occupancy0,
+                                         running)
         if timings is not None:
             timings["total_s"] = _time.perf_counter() - t0
         return packed, result
@@ -827,14 +858,25 @@ def place_graph_streamed(
         raise ValueError("graph has a cycle")
     offsets = offsets_buf[: n_levels + 1].copy()
 
-    runs = _plan_runs(offsets)
+    if mesh is not None:
+        n_shard = _mesh_shards(mesh)[2]
+        sharded_runs = _plan_runs_sharded(offsets, n_shard)
+        runs = [(Fl * n_shard, ws) for Fl, ws in sharded_runs]
+    else:
+        sharded_runs = None
+        runs = _plan_runs(offsets)
     Tp = T + _compute_pad(T, runs, offsets)
     Lp = _bucket(n_levels + 1, floor=64)
     # host fill targets are Tp-sized with a zero tail so chunk windows
     # (fixed length C, clamped into [0, Tp)) always slice cleanly; only
     # the tail needs zeroing — the fill chunks cover every row in [0, T)
     # and np.zeros over six 1M-row arrays costs real milliseconds of the
-    # serial phase on a one-core host
+    # serial phase on a one-core host.  The SHARDED dispatch assembles
+    # run tiles straight from these host arrays, and a tile window can
+    # overread rows the filler has not reached yet (bucket overshoot
+    # into the next wave) — those lanes are validity-masked on device
+    # but must not be garbage (NaN-free gathers), so the mesh path
+    # zero-fills everything up front.
     dur_s = np.empty(Tp, np.float32)
     heavy_s = np.empty(Tp, np.int32)
     heavy2_s = np.empty(Tp, np.int32)
@@ -842,7 +884,10 @@ def place_graph_streamed(
     xp2_s = np.empty(Tp, np.float32)
     xa_s = np.empty(Tp, np.float32)
     for _buf in (dur_s, heavy_s, heavy2_s, xp_s, xp2_s, xa_s):
-        _buf[T:] = 0
+        if mesh is not None:
+            _buf[:] = 0
+        else:
+            _buf[T:] = 0
     packed = PackedGraph(
         perm=perm, level=level, offsets=offsets, n_levels=int(n_levels),
         duration_s=dur_s[:T], heavy_s=heavy_s[:T], heavy2_s=heavy2_s[:T],
@@ -854,6 +899,8 @@ def place_graph_streamed(
     wide, uniform, thr_h, run_h, occ_h = _worker_params(
         nthreads, occupancy0, running
     )
+    if mesh is not None:
+        compact = False  # sharded wire is always the exact f16 format
     if compact == "auto":
         # the 11 B/task format exists to shrink the H2D wire of tunneled
         # accelerators; on the cpu backend "upload" is a memcpy and the
@@ -864,7 +911,9 @@ def place_graph_streamed(
         timings["fmt"] = fmt
 
     C = min(chunk_rows, T)
-    if fmt == "packed":
+    if mesh is not None:
+        bufs = None  # sharded runs ship per-run tiles, no chunk buffers
+    elif fmt == "packed":
         bufs = (
             jnp.zeros(Tp, jnp.float16), jnp.zeros(Tp, jnp.int32),
             jnp.zeros(Tp, jnp.uint16), jnp.zeros(Tp, jnp.uint8),
@@ -908,35 +957,43 @@ def place_graph_streamed(
     th = threading.Thread(target=filler, name="graphpack-fill", daemon=True)
     th.start()
 
-    rs = _RunState(packed, Tp, Lp, wide, uniform,
-                   jnp.asarray(thr_h), jnp.asarray(run_h),
-                   jnp.asarray(occ_h), fmt=fmt)
+    if mesh is not None:
+        rs: _RunState = _ShardedRunState(
+            mesh, packed, Tp, Lp, wide, uniform, thr_h, run_h, occ_h,
+            fleet_dev=fleet_dev, stats=stats,
+        )
+        host_fill = (dur_s, heavy_s, heavy2_s, xp_s, xp2_s, xa_s)
+    else:
+        rs = _RunState(packed, Tp, Lp, wide, uniform,
+                       jnp.asarray(thr_h), jnp.asarray(run_h),
+                       jnp.asarray(occ_h), fmt=fmt)
     run_i = 0
     for (i0, i1), evt in zip(boundaries, done):
         evt.wait()
         if fill_err:
             raise RuntimeError("graph pack fill failed") from fill_err[0]
-        # fixed-length window clamped into the buffers: the last chunk
-        # re-sends a few already-final rows instead of changing shape
-        # (one compiled _apply_chunk per chunk length)
-        start = min(i0, Tp - C)
-        sl = slice(start, start + C)
-        if fmt == "packed":
-            lo, hi = _enc_heavy_pair(heavy_s[sl], heavy2_s[sl])
-            host = (
-                dur_s[sl].astype(np.float16), lo, hi,
-                _enc_cost(xp_s[sl]), _enc_cost(xp2_s[sl]),
-                _enc_cost(xa_s[sl]),
-            )
-        else:
-            host = (
-                dur_s[sl].astype(np.float16),
-                heavy_s[sl], heavy2_s[sl],
-                xp_s[sl].astype(np.float16),
-                xp2_s[sl].astype(np.float16),
-                xa_s[sl].astype(np.float16),
-            )
-        bufs = _apply_chunk(bufs, jax.device_put(host), jnp.int32(start))
+        if mesh is None:
+            # fixed-length window clamped into the buffers: the last
+            # chunk re-sends a few already-final rows instead of
+            # changing shape (one compiled _apply_chunk per length)
+            start = min(i0, Tp - C)
+            sl = slice(start, start + C)
+            if fmt == "packed":
+                lo, hi = _enc_heavy_pair(heavy_s[sl], heavy2_s[sl])
+                host = (
+                    dur_s[sl].astype(np.float16), lo, hi,
+                    _enc_cost(xp_s[sl]), _enc_cost(xp2_s[sl]),
+                    _enc_cost(xa_s[sl]),
+                )
+            else:
+                host = (
+                    dur_s[sl].astype(np.float16),
+                    heavy_s[sl], heavy2_s[sl],
+                    xp_s[sl].astype(np.float16),
+                    xp2_s[sl].astype(np.float16),
+                    xa_s[sl].astype(np.float16),
+                )
+            bufs = _apply_chunk(bufs, jax.device_put(host), jnp.int32(start))
         # dispatch every fused run whose rows have fully landed; its
         # windows may read a few rows past i1 — still the zero fill,
         # masked by the wave's validity lanes
@@ -944,11 +1001,22 @@ def place_graph_streamed(
             run_i < len(runs)
             and int(offsets[runs[run_i][1][-1] + 1]) <= i1
         ):
-            F, waves = runs[run_i]
-            rs.dispatch(bufs, F, waves, last=run_i == len(runs) - 1)
+            if mesh is not None:
+                # sharded: assemble [K, F] tiles from the host fill
+                # arrays and ship each shard exactly its slice — the
+                # async per-shard H2D overlaps both the pack fill and
+                # the earlier runs' compute
+                Fl, waves = sharded_runs[run_i]
+                rs.dispatch(host_fill, Fl, waves,
+                            last=run_i == len(runs) - 1)
+            else:
+                F, waves = runs[run_i]
+                rs.dispatch(bufs, F, waves, last=run_i == len(runs) - 1)
             run_i += 1
     th.join()
     assert run_i == len(runs), "not all runs dispatched"
+    if mesh is not None:
+        rs.record_shard_ms()
     result = rs.finalize()
     if timings is not None:
         timings["total_s"] = _time.perf_counter() - t0
@@ -1012,3 +1080,426 @@ def validate_leveled(
     lv = result.level
     real = src != dst
     assert (lv[dst[real]] > lv[src[real]]).all(), "level order violated"
+
+
+# ----------------------------------------------------- sharded engine
+#
+# The same level-synchronous placement, partitioned over a
+# ``jax.sharding.Mesh`` (ops/partition.make_engine_mesh: 2-D
+# ``(tasks, workers)``): every wave's task slice is split CONTIGUOUSLY
+# over the flattened device order (device d of D owns window rows
+# ``[d*Fl, (d+1)*Fl)``), the fleet SoA rows shard over the ``workers``
+# axis (the mirror's slot->shard mapping, scheduler/mirror.py), and the
+# per-wave combine is exactly two collectives: a ``psum`` of the wave's
+# worker-load vector and an ``all_gather`` of its assignment slice so
+# the next wave's locality gathers see the full picture.  On a 1x1 mesh
+# the collectives are identities and the kernel computes the same
+# floating-point expressions in the same order as ``_place_run`` — the
+# sharded path is the identity refactor there (property-tested in
+# tests/test_sharded_engine.py).
+
+
+def _mesh_shards(mesh):
+    """(axis names, per-axis sizes, total shard count) of an engine mesh."""
+    names = tuple(mesh.axis_names)
+    sizes = tuple(int(mesh.shape[a]) for a in names)
+    D = 1
+    for s in sizes:
+        D *= s
+    return names, sizes, D
+
+
+def _plan_runs_sharded(offsets: np.ndarray, n_shards: int):
+    """Sharded analogue of :func:`_plan_runs` (the same grouping loop):
+    fused runs ``[(Fl, [wave, ...])]`` where ``Fl`` is the PER-SHARD
+    pow2 bucket of the wave size (ops/partition.shard_bucket) — one
+    fused dispatch per group, every shard's slice a static
+    ``Fl``-length window."""
+    from distributed_tpu.ops.partition import shard_bucket
+
+    return _plan_runs(
+        offsets,
+        bucket_fn=lambda f: shard_bucket(f, n_shards, floor=512),
+        small=max(SMALL_WAVE // max(n_shards, 1), 2048),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_run_fn(mesh, Fl: int, K: int, W: int, uniform: bool,
+                    fleet_sharded: bool):
+    """Build (and cache) the jitted shard_map program for one fused run
+    shape class.  Mirrors ``_place_run``'s per-wave body (both the
+    uniform fast path and the general path) on per-shard slices; see the
+    module-tail comment for the collective structure."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tpu.ops.partition import shard_map_compat
+
+    names, sizes, D = _mesh_shards(mesh)
+
+    def local(dur_g, heavy_g, heavy2_g, xp_g, xp2_g, xa_g,
+              assign, choices, load, spans, offs, fs, widxs,
+              nthreads, running, occ0):
+        # task arrays: [K, Fl] local tiles; assign/choices/load/spans
+        # replicated; fleet arrays are "workers"-axis shards when the
+        # mirror feeds the kernel, else full replicated [W]
+        if fleet_sharded:
+            nthreads = lax.all_gather(nthreads, "workers", tiled=True)
+            running = lax.all_gather(running, "workers", tiled=True)
+            occ0 = lax.all_gather(occ0, "workers", tiled=True)
+        threads_f = jnp.maximum(nthreads, 1).astype(jnp.float32)
+        inv_t = 1.0 / threads_f
+        w_run = jnp.maximum(
+            (running & (nthreads > 0)).sum(), 1
+        ).astype(jnp.int32)
+        INF = jnp.float32(np.inf)
+        ovt0 = jnp.where(running, occ0 * inv_t, INF)
+        ovt_c = occ0[0] * inv_t[0]  # uniform-path scalar
+        inv_c = inv_t[0]
+        # linear shard index in the flattened (row-major) device order —
+        # the order NamedSharding splits the task dimension in
+        shard = jnp.int32(0)
+        stride = D
+        for a, s in zip(names, sizes):
+            stride //= s
+            shard = shard + lax.axis_index(a).astype(jnp.int32) * stride
+        rank = shard * Fl + jnp.arange(Fl, dtype=jnp.int32)
+
+        def body(k, carry):
+            offset = offs[k]
+            f = fs[k]
+
+            def run_wave(carry):
+                assign, choices, load, spans = carry
+                dur = lax.dynamic_index_in_dim(
+                    dur_g, k, 0, keepdims=False
+                ).astype(jnp.float32)
+                heavy = lax.dynamic_index_in_dim(heavy_g, k, 0, keepdims=False)
+                heavy2 = lax.dynamic_index_in_dim(
+                    heavy2_g, k, 0, keepdims=False
+                )
+                xp = lax.dynamic_index_in_dim(
+                    xp_g, k, 0, keepdims=False
+                ).astype(jnp.float32)
+                xp2 = lax.dynamic_index_in_dim(
+                    xp2_g, k, 0, keepdims=False
+                ).astype(jnp.float32)
+                xa = lax.dynamic_index_in_dim(
+                    xa_g, k, 0, keepdims=False
+                ).astype(jnp.float32)
+                valid = rank < f
+
+                h = jnp.maximum(heavy, 0)
+                pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
+                p = jnp.maximum(pref, 0)
+                ok1 = pref >= 0
+                h2 = jnp.maximum(heavy2, 0)
+                pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
+                p2 = jnp.maximum(pref2, 0)
+                ok2 = (pref2 >= 0) & (pref2 != pref)
+
+                order = jnp.argsort(
+                    jnp.where(running, load * inv_t, jnp.inf)
+                )
+                block = jnp.maximum((f + w_run - 1) // w_run, 1)
+                slot = jnp.clip(rank // block, 0, W - 1)
+                spread = order[slot]
+
+                if uniform:
+                    c0 = jnp.where(ok1, xp + ovt_c, INF)
+                    c1 = jnp.where(ok2, xp2 + ovt_c, INF)
+                    c2 = xa + ovt_c
+                else:
+                    c0 = jnp.where(ok1, ovt0[p] + xp, INF)
+                    c1 = jnp.where(ok2, ovt0[p2] + xp2, INF)
+                    c2 = ovt0[spread] + xa
+                choice = _argmin3(c0, c1, c2)
+                tent = _sel3(choice, p, p2, spread)
+                xfer_t = _sel3(choice, xp, xp2, xa)
+
+                tw = jnp.where(valid, dur + xfer_t, 0.0)
+                tl = lax.psum(
+                    jax.ops.segment_sum(
+                        tw, jnp.maximum(tent, 0), num_segments=W
+                    ),
+                    names,
+                )
+                if uniform:
+                    tli = tl * inv_c
+                    corr = tw * inv_c
+                    d0 = jnp.where(
+                        ok1,
+                        tli[p] - jnp.where(p == tent, corr, 0.0)
+                        + xp + ovt_c,
+                        INF,
+                    )
+                    d1 = jnp.where(
+                        ok2,
+                        tli[p2] - jnp.where(p2 == tent, corr, 0.0)
+                        + xp2 + ovt_c,
+                        INF,
+                    )
+                    d2 = (
+                        tli[spread]
+                        - jnp.where(spread == tent, corr, 0.0)
+                        + xa + ovt_c
+                    )
+                else:
+                    s_tab = ovt0 + tl * inv_t
+                    corr = tw * inv_t[tent]
+                    d0 = jnp.where(
+                        ok1,
+                        s_tab[p] - jnp.where(p == tent, corr, 0.0) + xp,
+                        INF,
+                    )
+                    d1 = jnp.where(
+                        ok2,
+                        s_tab[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2,
+                        INF,
+                    )
+                    d2 = (
+                        s_tab[spread]
+                        - jnp.where(spread == tent, corr, 0.0) + xa
+                    )
+                choice = _argmin3(d0, d1, d2)
+                assign_w = _sel3(choice, p, p2, spread)
+                xfer = _sel3(choice, xp, xp2, xa)
+                assign_w = jnp.where(valid, assign_w, -1)
+
+                work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
+                wave_load = lax.psum(
+                    jax.ops.segment_sum(
+                        work, jnp.maximum(assign_w, 0), num_segments=W
+                    ),
+                    names,
+                )
+                load = load + wave_load
+                span = jnp.where(running, wave_load * inv_t, 0.0).max()
+                spans = spans.at[widxs[k]].set(span)
+                # republish this wave's slice to every shard: tiled
+                # gather over the flattened device order reassembles the
+                # CONTIGUOUS [F] window (shard d holds rows [d*Fl, ...))
+                afull = lax.all_gather(assign_w, names, tiled=True)
+                cfull = lax.all_gather(choice, names, tiled=True)
+                assign = lax.dynamic_update_slice(assign, afull, (offset,))
+                choices = lax.dynamic_update_slice(choices, cfull, (offset,))
+                return assign, choices, load, spans
+
+            if K == 1:
+                return run_wave(carry)
+            return lax.cond(f > 0, run_wave, lambda c: c, carry)
+
+        if K == 1:
+            out = body(0, (assign, choices, load, spans))
+        else:
+            out = lax.fori_loop(0, K, body, (assign, choices, load, spans))
+        return out
+
+    fleet_spec = P("workers") if fleet_sharded else P(None)
+    fn = shard_map_compat(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(None, names), P(None, names), P(None, names),
+            P(None, names), P(None, names), P(None, names),
+            P(None), P(None), P(None), P(None),
+            P(None), P(None), P(None),
+            fleet_spec, fleet_spec, fleet_spec,
+        ),
+        out_specs=(P(None), P(None), P(None), P(None)),
+    )
+    return jax.jit(fn, donate_argnums=(6, 7, 8, 9))
+
+
+class _ShardedRunState(_RunState):
+    """Dispatch/download driver for the mesh-sharded engine.
+
+    Differs from the single-device ``_RunState`` in the upload plane:
+    instead of six persistent ``Tp``-sized device buffers written by
+    chunk, each fused run ships a ``[K, F]`` tile set placed with
+    ``NamedSharding`` — every shard receives EXACTLY its ``[K, Fl]``
+    slice (per-shard H2D), and the async ``device_put`` overlaps the
+    transfer against earlier runs still computing.  Segmented D2H is
+    inherited unchanged.
+    """
+
+    def __init__(self, mesh, packed: PackedGraph, Tp: int, Lp: int,
+                 wide: bool, uniform: bool, thr_h, run_h, occ_h,
+                 fleet_dev=None, stats: dict | None = None):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.names, self.axis_sizes, self.D = _mesh_shards(mesh)
+        self.packed = packed
+        self.Tp = Tp
+        self.Lp = Lp
+        self.wide = wide
+        self.uniform = uniform
+        self.sizes = np.diff(packed.offsets)
+        rep = NamedSharding(mesh, P(None))
+        self.assign = _jax.device_put(np.full(Tp, -1, np.int32), rep)
+        self.choices = _jax.device_put(np.full(Tp, 2, np.int32), rep)
+        self.load = _jax.device_put(np.asarray(occ_h, np.float32), rep)
+        self.spans = _jax.device_put(np.zeros(Lp, np.float32), rep)
+        if fleet_dev is not None:
+            # mirror-resident fleet shards (scheduler/mirror.py
+            # sharded_device_view): ZERO fleet H2D on this plan — the
+            # kernel reads the rows each shard already holds
+            self.fleet = (
+                fleet_dev["nthreads"], fleet_dev["running"],
+                fleet_dev["occupancy"],
+            )
+            self.fleet_sharded = True
+        else:
+            self.fleet = tuple(
+                _jax.device_put(a, rep) for a in (thr_h, run_h, occ_h)
+            )
+            self.fleet_sharded = False
+        self.task_sharding = NamedSharding(mesh, P(None, self.names))
+        self.segments = []
+        self.seg_from = 0
+        self.SEG_MIN = max(packed.n // 4, 4096)
+        self.stats = stats
+        if stats is not None:
+            stats["n_shards"] = self.D
+            stats["runs"] = 0
+            stats["shards"] = [
+                {"shard": d, "h2d_bytes": 0, "kernel_ms": 0.0}
+                for d in range(self.D)
+            ]
+
+    _TASK_DTYPES = (np.float16, np.int32, np.int32,
+                    np.float16, np.float16, np.float16)
+
+    def dispatch(self, host_bufs, Fl: int, waves: list[int],
+                 last: bool) -> None:
+        """Assemble one fused run's [K, F] tiles from the Tp-sized host
+        arrays, ship them sharded, and enqueue the kernel."""
+        import jax as _jax
+
+        packed = self.packed
+        D = self.D
+        F = Fl * D
+        K = _bucket(len(waves), floor=1)
+        offs = np.full(K, packed.n, np.int32)
+        fs = np.zeros(K, np.int32)
+        widxs = np.full(K, self.Lp - 1, np.int32)
+        for i, w in enumerate(waves):
+            offs[i] = packed.offsets[w]
+            fs[i] = self.sizes[w]
+            widxs[i] = w
+        tiles = []
+        for buf, dtype in zip(host_bufs, self._TASK_DTYPES):
+            tile = np.zeros((K, F), dtype)
+            for i, w in enumerate(waves):
+                off = int(packed.offsets[w])
+                tile[i] = buf[off: off + F]
+            tiles.append(tile)
+        tiles = _jax.device_put(tuple(tiles), self.task_sharding)
+        if self.stats is not None:
+            per_shard = sum(K * Fl * t.dtype.itemsize for t in tiles)
+            for row in self.stats["shards"]:
+                row["h2d_bytes"] += per_shard
+            self.stats["runs"] += 1
+        W = int(self.fleet[0].shape[0])
+        fn = _sharded_run_fn(
+            self.mesh, Fl, K, W, self.uniform, self.fleet_sharded
+        )
+        self.assign, self.choices, self.load, self.spans = fn(
+            *tiles,
+            self.assign, self.choices, self.load, self.spans,
+            jnp.asarray(offs), jnp.asarray(fs), jnp.asarray(widxs),
+            *self.fleet,
+        )
+        self._maybe_segment(int(packed.offsets[waves[-1] + 1]), last)
+
+    def record_shard_ms(self) -> None:
+        """Per-shard completion wall, measured AFTER the last dispatch
+        was enqueued and BEFORE the blocking host fetch: shard d's entry
+        is the time until its copy of the final carry went ready.
+
+        The probe blocks shard-by-shard IN ORDER, so the series is
+        cumulative (monotone non-decreasing): a later shard can never
+        read lower than an earlier one, and a straggler inflates every
+        shard behind it — read the FIRST shard's value as the pipeline
+        drain time and a large step between neighbours as "the earlier
+        shard was the straggler".  Per-device completion timestamps
+        would need device events jax does not expose portably."""
+        if self.stats is None:
+            return
+        import time as _time
+
+        order = {
+            d.id: i for i, d in enumerate(self.mesh.devices.flatten())
+        }
+        t0 = _time.perf_counter()
+        try:
+            shards = sorted(
+                self.assign.addressable_shards,
+                key=lambda s: order.get(s.device.id, 0),
+            )
+            for s in shards:
+                s.data.block_until_ready()
+                i = order.get(s.device.id, 0)
+                self.stats["shards"][i]["kernel_ms"] = round(
+                    (_time.perf_counter() - t0) * 1e3, 3
+                )
+        except AttributeError:  # pragma: no cover - non-array backend
+            pass
+
+
+def place_graph_leveled_sharded(
+    mesh,
+    packed: PackedGraph,
+    nthreads,
+    occupancy0,
+    running,
+    *,
+    fleet_dev=None,
+    stats: dict | None = None,
+) -> LeveledResult:
+    """Place the whole graph as one partitioned program over ``mesh``.
+
+    Semantics match :func:`place_graph_leveled`; on a 1x1 mesh the
+    result is bit-identical.  ``fleet_dev`` takes the mirror's
+    ``sharded_device_view`` arrays (capacity-sized, ``workers``-axis
+    shards) so a fresh cycle ships zero fleet rows; the host
+    ``nthreads``/``occupancy0``/``running`` are still required — they
+    seed the replicated load carry and the uniform/wide host decisions —
+    and must mirror the device rows (the mirror guarantees it).
+    ``stats`` (optional dict) receives per-shard H2D bytes and kernel
+    completion ms.
+    """
+    T = packed.n
+    names, sizes, D = _mesh_shards(mesh)
+    runs = _plan_runs_sharded(packed.offsets, D)
+    Tp = T + _compute_pad(
+        T, [(Fl * D, ws) for Fl, ws in runs], packed.offsets
+    )
+    Lp = _bucket(packed.n_levels + 1, floor=64)
+
+    def pad_buf(arr, fill, dtype):
+        buf = np.empty(Tp, dtype)
+        buf[:T] = arr
+        buf[T:] = fill
+        return buf
+
+    host_bufs = (
+        pad_buf(packed.duration_s, 0, np.float16),
+        pad_buf(packed.heavy_s, 0, np.int32),   # pad 0: safe gather index
+        pad_buf(packed.heavy2_s, 0, np.int32),
+        pad_buf(packed.xfer_pref_s, 0, np.float16),
+        pad_buf(packed.xfer_pref2_s, 0, np.float16),
+        pad_buf(packed.xfer_all_s, 0, np.float16),
+    )
+    wide, uniform, thr_h, run_h, occ_h = _worker_params(
+        nthreads, occupancy0, running
+    )
+    rs = _ShardedRunState(mesh, packed, Tp, Lp, wide, uniform,
+                          thr_h, run_h, occ_h,
+                          fleet_dev=fleet_dev, stats=stats)
+    for run_i, (Fl, waves) in enumerate(runs):
+        rs.dispatch(host_bufs, Fl, waves, last=run_i == len(runs) - 1)
+    rs.record_shard_ms()
+    return rs.finalize()

@@ -103,6 +103,90 @@ def jax_available(timeout: float = 20.0) -> bool:
     return _jax_probe_result
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """Version-tolerant ``shard_map``: ``jax.shard_map`` (jax >= 0.7,
+    ``check_vma``) with a fallback to ``jax.experimental.shard_map``
+    (jax 0.4.x, ``check_rep``).  Replication checking is disabled on
+    both: the engine kernels combine per-shard results with explicit
+    collectives and return replicated outputs the checker cannot see
+    through."""
+    try:
+        from jax import shard_map as _sm  # jax >= ~0.6
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    try:
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except TypeError:
+        # mid-band jax: top-level shard_map exists but predates the
+        # check_rep -> check_vma rename
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+#: mesh axis names of the scheduler engine mesh, in order: "tasks" is the
+#: data-parallel wave axis, "workers" shards the fleet SoA rows
+ENGINE_AXES = ("tasks", "workers")
+
+
+def make_engine_mesh(n_devices: int | None = None, layout: str = "auto",
+                     devices=None):
+    """The scheduler co-processor mesh: 2-D ``(tasks, workers)``.
+
+    The leveled engine splits every wave's task slice over BOTH axes
+    (the flattened device order), while the fleet mirror's SoA rows
+    shard over ``"workers"`` only (replicated along ``"tasks"``) — see
+    ``scheduler/mirror.py.sharded_device_view`` and
+    ``ops/leveled.place_graph_leveled_sharded``.
+
+    ``layout`` is ``"auto"`` (factor ``n`` as close to square as
+    possible, workers axis the smaller factor) or an explicit ``"TxW"``
+    string, e.g. ``"4x2"``.  ``n_devices`` of ``None``/``0`` means all
+    visible devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    _pin_cpu_if_requested(jax)
+    if devices is None:
+        devices = jax.devices()
+    if n_devices:
+        # truncate to what exists (the historical make_mesh semantics):
+        # asking for 8 on a 2-device host yields the 2-device mesh; an
+        # EXPLICIT "TxW" layout below still raises when unsatisfiable
+        devices = devices[: min(n_devices, len(devices))]
+    n = len(devices)
+    if layout and layout != "auto":
+        dt, dw = (int(p) for p in str(layout).lower().split("x"))
+        if dt * dw > n:
+            raise ValueError(f"layout {layout} needs {dt*dw} devices, have {n}")
+        devices = devices[: dt * dw]
+    else:
+        dw = 1
+        for f in range(int(np.sqrt(n)), 0, -1):
+            if n % f == 0:
+                dw = f
+                break
+        dt = n // dw
+    dev_array = np.asarray(devices).reshape(dt, dw)
+    return Mesh(dev_array, axis_names=ENGINE_AXES)
+
+
+def shard_bucket(n: int, n_shards: int, floor: int = 2048) -> int:
+    """Per-shard power-of-two bucket for a wave of ``n`` tasks split
+    over ``n_shards`` devices — the sharded engine's analogue of
+    ``ops.leveled._bucket``: bounds distinct jit shapes while keeping
+    every shard's slice the same (static) length."""
+    need = max(-(-n // max(n_shards, 1)), 1)
+    b = floor
+    while b < need:
+        b *= 2
+    return b
+
+
 def block_init(durations: np.ndarray, n_workers: int) -> np.ndarray:
     """Equal-load contiguous blocks over the (priority-sorted) task
     axis: label[i] = which of the W cumulative-duration buckets the

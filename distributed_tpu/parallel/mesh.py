@@ -1,16 +1,14 @@
 """Device-mesh sharding for the placement co-processor.
 
-Two engines are sharded here:
+Two engines are exposed here:
 
 - ``sharded_decide_workers`` — the round-1 batched decide_worker
   (dense [B, W] cost tiles over a 2-D tasks x workers mesh);
-- ``place_graph_leveled_sharded`` — the LIVE second-generation leveled
-  engine (ops/leveled.py), data-parallel over the wave axis: each
-  device places a slice of every wave, worker-load vectors combine via
-  ``psum`` and the wave's assignment is ``all_gather``-ed so the next
-  wave's locality gathers see the full picture.  This is the engine the
-  product scheduler runs (scheduler/jax_placement.py), so multi-chip
-  evidence covers the real code path.
+- ``place_graph_leveled_sharded`` — a thin re-export of the SHARED
+  sharded leveled engine (``ops/leveled.place_graph_leveled_sharded``):
+  the one the product scheduler runs through its mesh plan path
+  (scheduler/jax_placement.py), so the MULTICHIP dryrun gates the real
+  code path instead of a parallel implementation.
 
 Scales the scheduler kernels beyond one chip the TPU way (SURVEY.md §2.3
 "TPU-native equivalent"): a 2-D ``jax.sharding.Mesh`` with axes
@@ -25,13 +23,12 @@ Scales the scheduler kernels beyond one chip the TPU way (SURVEY.md §2.3
 The [B, W] cost matrix only ever exists as [B/dt, W/dw] tiles, one per
 device.  Dependency edge lists are replicated (they are O(E) ints) and each
 task-shard masks the edges that land in its row range — bandwidth-cheap and
-keeps the segment-sum local.  ``shard_map`` keeps the collectives explicit;
-XLA lowers them onto ICI.
+keeps the segment-sum local.  ``shard_map`` (via
+``ops.partition.shard_map_compat``, version-tolerant across the jax 0.4/0.7
+API split) keeps the collectives explicit; XLA lowers them onto ICI.
 """
 
 from __future__ import annotations
-
-import math
 
 import jax
 import jax.numpy as jnp
@@ -39,25 +36,16 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tpu.ops.placement import WorkerArrays, PlacementBatch
-
-from jax import shard_map  # jax >= 0.7 (this repo targets jax 0.9)
+from distributed_tpu.ops.partition import make_engine_mesh, shard_map_compat
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """Factor available devices into a (tasks, workers) mesh, e.g. 8 -> 4x2."""
-    if devices is None:
-        devices = jax.devices()
-    if n_devices is not None:
-        devices = devices[:n_devices]
-    n = len(devices)
-    d_workers = 1
-    for f in range(int(math.isqrt(n)), 0, -1):
-        if n % f == 0:
-            d_workers = f
-            break
-    d_tasks = n // d_workers
-    dev_array = np.asarray(devices).reshape(d_tasks, d_workers)
-    return Mesh(dev_array, axis_names=("tasks", "workers"))
+    """Factor available devices into a (tasks, workers) mesh, e.g. 8 -> 4x2.
+
+    Thin alias of :func:`ops.partition.make_engine_mesh` — one mesh
+    constructor serves the dryrun, the tests and the product plan path.
+    """
+    return make_engine_mesh(n_devices, devices=devices)
 
 
 def sharded_decide_workers(
@@ -155,7 +143,7 @@ def sharded_decide_workers(
     if restrict is None:
         restrict = jnp.ones((B, W), bool)
 
-    fn = shard_map(
+    fn = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(
@@ -166,7 +154,6 @@ def sharded_decide_workers(
             P("tasks", "workers"),                # restrict tiles
         ),
         out_specs=P("tasks"),
-        check_vma=False,
     )
     with mesh:
         return fn(
@@ -177,151 +164,26 @@ def sharded_decide_workers(
 
 
 # ---------------------------------------------------------------------
-# sharded leveled engine (the live scheduler engine, ops/leveled.py)
+# sharded leveled engine — the SHARED implementation lives in
+# ops/leveled.py (place_graph_leveled_sharded: fused wave runs,
+# per-shard H2D, psum/all_gather combine); this wrapper keeps the
+# dryrun-era call shape so the MULTICHIP gate exercises exactly the
+# engine the product scheduler's mesh plan path runs.
 # ---------------------------------------------------------------------
-
-
-def _leveled_wave_sharded(mesh: Mesh, axis: str, Fl: int, W: int):
-    """One wave of the leveled placement, tasks sharded over ``axis``.
-
-    Per-device: place a contiguous Fl-slice of the wave against the
-    REPLICATED assignment/load state; combine worker loads with ``psum``
-    and republish the wave's assignment with ``all_gather`` — the
-    level-synchronous structure makes the collectives exactly two per
-    wave.  Mirrors ops/leveled._place_run's per-wave body (uniform=False
-    path) on sharded arrays.
-    """
-    import jax.numpy as jnp
-    from jax import lax
-
-    def local(dur, heavy, heavy2, xp, xp2, xa, valid,
-              assign, load, nthreads, running, occ0):
-        # dur..valid: [Fl] local slice; assign/load/occ0: replicated
-        threads_f = jnp.maximum(nthreads, 1).astype(jnp.float32)
-        inv_t = 1.0 / threads_f
-        w_run = jnp.maximum(
-            (running & (nthreads > 0)).sum(), 1
-        ).astype(jnp.int32)
-        ovt0 = jnp.where(running, occ0 * inv_t, jnp.inf)
-        shard_i = lax.axis_index(axis)
-        rank = shard_i * Fl + jnp.arange(Fl, dtype=jnp.int32)
-        f = valid.sum()
-        f = lax.psum(f, axis)
-
-        h = jnp.maximum(heavy, 0)
-        pref = jnp.where((heavy >= 0) & valid, assign[h], -1)
-        p = jnp.maximum(pref, 0)
-        ok1 = pref >= 0
-        h2 = jnp.maximum(heavy2, 0)
-        pref2 = jnp.where((heavy2 >= 0) & valid, assign[h2], -1)
-        p2 = jnp.maximum(pref2, 0)
-        ok2 = (pref2 >= 0) & (pref2 != pref)
-
-        order = jnp.argsort(jnp.where(running, load * inv_t, jnp.inf))
-        block = jnp.maximum((f + w_run - 1) // w_run, 1)
-        slot = jnp.clip(rank // block, 0, W - 1)
-        spread = order[slot]
-
-        INF = jnp.float32(np.inf)
-        c0 = jnp.where(ok1, ovt0[p] + xp, INF)
-        c1 = jnp.where(ok2, ovt0[p2] + xp2, INF)
-        c2 = ovt0[spread] + xa
-        ch = jnp.where(
-            (c0 <= c1) & (c0 <= c2), 0, jnp.where(c1 <= c2, 1, 2)
-        ).astype(jnp.int32)
-        tent = jnp.where(ch == 0, p, jnp.where(ch == 1, p2, spread))
-        xfer_t = jnp.where(ch == 0, xp, jnp.where(ch == 1, xp2, xa))
-
-        tw = jnp.where(valid, dur + xfer_t, 0.0)
-        tl_local = jax.ops.segment_sum(
-            tw, jnp.maximum(tent, 0), num_segments=W
-        )
-        tl = lax.psum(tl_local, axis)  # ICI: combine wave load
-        s_tab = ovt0 + tl * inv_t
-        corr = tw * inv_t[tent]
-        d0 = jnp.where(ok1, s_tab[p] - jnp.where(p == tent, corr, 0.0) + xp, INF)
-        d1 = jnp.where(ok2, s_tab[p2] - jnp.where(p2 == tent, corr, 0.0) + xp2, INF)
-        d2 = s_tab[spread] - jnp.where(spread == tent, corr, 0.0) + xa
-        ch = jnp.where(
-            (d0 <= d1) & (d0 <= d2), 0, jnp.where(d1 <= d2, 1, 2)
-        ).astype(jnp.int32)
-        assign_w = jnp.where(ch == 0, p, jnp.where(ch == 1, p2, spread))
-        xfer = jnp.where(ch == 0, xp, jnp.where(ch == 1, xp2, xa))
-        assign_w = jnp.where(valid, assign_w, -1)
-
-        work = jnp.where(assign_w >= 0, dur + xfer, 0.0)
-        wl_local = jax.ops.segment_sum(
-            work, jnp.maximum(assign_w, 0), num_segments=W
-        )
-        wave_load = lax.psum(wl_local, axis)  # ICI: wave occupancy
-        # republish this wave's assignment to every shard
-        assign_full = lax.all_gather(assign_w, axis, tiled=True)
-        return assign_full, wave_load
-
-    from jax import shard_map
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(
-            P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
-            P(None), P(None), P(None), P(None), P(None),
-        ),
-        out_specs=(P(None), P(None)),
-        check_vma=False,
-    )
-    return jax.jit(fn)
 
 
 def place_graph_leveled_sharded(mesh, packed, nthreads, occupancy0,
                                 running, axis: str = "tasks"):
-    """The leveled engine data-parallel over an n-device mesh: every
-    wave's task slice is placed on its own device; two collectives per
-    wave (psum of worker loads, all_gather of the assignment).
+    """Run the shared sharded leveled engine over ``mesh``.
 
     Returns (assignment i32[T] in ORIGINAL order, load f32[W]) —
     semantics matching ops.leveled.place_graph_leveled's core outputs.
+    ``axis`` is accepted for dryrun-era compatibility; the shared engine
+    splits every wave over ALL mesh axes.
     """
-    import jax.numpy as jnp
+    from distributed_tpu.ops.leveled import (
+        place_graph_leveled_sharded as _engine,
+    )
 
-    n_shard = mesh.shape[axis]
-    T = packed.n
-    W = len(np.asarray(occupancy0))
-    sizes = np.diff(packed.offsets)
-    Fl = int(-(-int(sizes.max()) // n_shard)) if T else 1
-    Fl = max(Fl, 1)
-    F = Fl * n_shard
-
-    assign = jnp.full(T + F, -1, jnp.int32)
-    load = jnp.asarray(np.asarray(occupancy0, np.float32))
-    occ0 = load + 0.0
-    nthreads_j = jnp.asarray(np.asarray(nthreads, np.int32))
-    running_j = jnp.asarray(np.asarray(running, bool))
-    wave_fn = _leveled_wave_sharded(mesh, axis, Fl, W)
-
-    def pad(arr, off, n, fill, dtype):
-        buf = np.full(F, fill, dtype)
-        buf[:n] = arr[off : off + n]
-        return jnp.asarray(buf)
-
-    with mesh:
-        for w in range(packed.n_levels):
-            off = int(packed.offsets[w])
-            n = int(sizes[w])
-            dur = pad(packed.duration_s, off, n, 0, np.float32)
-            heavy = pad(packed.heavy_s, off, n, -1, np.int32)
-            heavy2 = pad(packed.heavy2_s, off, n, -1, np.int32)
-            xp = pad(packed.xfer_pref_s, off, n, 0, np.float32)
-            xp2 = pad(packed.xfer_pref2_s, off, n, 0, np.float32)
-            xa = pad(packed.xfer_all_s, off, n, 0, np.float32)
-            valid = jnp.asarray(np.arange(F) < n)
-            assign_w, wave_load = wave_fn(
-                dur, heavy, heavy2, xp, xp2, xa, valid,
-                assign, load, nthreads_j, running_j, occ0,
-            )
-            load = load + wave_load
-            assign = assign.at[off : off + F].set(assign_w)
-
-    assignment = np.full(T, -1, np.int32)
-    assignment[packed.perm] = np.asarray(assign[:T])
-    return assignment, np.asarray(load)
+    res = _engine(mesh, packed, nthreads, occupancy0, running)
+    return res.assignment, res.occupancy

@@ -35,8 +35,16 @@ logger = logging.getLogger("distributed_tpu.jax_placement")
 
 _DEFAULT_NBYTES = 10_000.0  # cost-model guess for unobserved outputs
 
+_MESH_UNSET = object()  # mesh not built yet (vs. None = build failed/off)
+
 import os as _os
 _PARK_DEBUG: "list | None" = [] if _os.environ.get("DTPU_PARK_DEBUG") else None
+
+
+#: atexit grace for an in-flight plan: long enough for a normal XLA-CPU
+#: compile/dispatch to drain (seconds), short enough that a WEDGED
+#: accelerator tunnel still cannot pin the exit for more than this
+_EXIT_DRAIN_S = 15.0
 
 
 class _DaemonExecutor:
@@ -45,18 +53,30 @@ class _DaemonExecutor:
 
     ThreadPoolExecutor threads are non-daemon and joined at interpreter
     exit; a jax call blocked on a dead accelerator tunnel would pin the
-    process forever.  A daemon thread just dies with the process."""
+    process forever.  A daemon thread just dies with the process —
+    except that dying INSIDE an XLA compile/dispatch segfaults the
+    interpreter teardown (reproduced ~80% with the sharded engine's
+    seconds-long compiles in flight at exit), so an atexit hook waits a
+    BOUNDED ``_EXIT_DRAIN_S`` for the in-flight job before teardown
+    proceeds: normal plans drain, a wedged tunnel costs at most the
+    grace period."""
 
     def __init__(self, name: str):
+        import atexit
         import queue
         from concurrent.futures import Future
 
         self._Future = Future
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._pending = 0  # queued + running jobs, under _lock
+        self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
         )
         self._thread.start()
+        atexit.register(self._drain_at_exit)
 
     def _run(self) -> None:
         while True:
@@ -64,20 +84,44 @@ class _DaemonExecutor:
             if item is None:
                 return
             fut, fn, args = item
-            if not fut.set_running_or_notify_cancel():
-                continue
             try:
-                fut.set_result(fn(*args))
-            except BaseException as exc:  # noqa: BLE001 - relayed to waiter
-                fut.set_exception(exc)
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn(*args))
+                except BaseException as exc:  # noqa: BLE001 - to waiter
+                    fut.set_exception(exc)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+
+    def _drain_at_exit(self) -> None:
+        self._idle.wait(_EXIT_DRAIN_S)
 
     def submit(self, fn, *args):
         fut = self._Future()
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
         self._q.put((fut, fn, args))
         return fut
 
     def shutdown(self, wait: bool = False, cancel_futures: bool = False) -> None:
         self._q.put(None)
+        if self._idle.is_set():
+            # nothing in flight: drop the exit hook so repeated
+            # create/close cycles don't accumulate registrations.  With
+            # a job still running the hook MUST stay — close-then-exit
+            # mid-XLA-dispatch is exactly the teardown segfault the
+            # drain exists for.
+            import atexit
+
+            try:
+                atexit.unregister(self._drain_at_exit)
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
 
 
 def device_dispatch_worthwhile(n_workers: int, n_items: int,
@@ -139,6 +183,15 @@ class JaxPlacement:
             sync if sync is not None
             else bool(config.get("scheduler.jax.sync-plan"))
         )
+        # device-mesh sharding (scheduler.jax.mesh subtree): when
+        # enabled, the leveled engine runs as ONE partitioned XLA
+        # program over the mesh and the fleet half comes from the
+        # mirror's workers-axis shards; any failure falls back to the
+        # single-device engine, which falls back to the python oracle.
+        self.mesh_enabled = bool(config.get("scheduler.jax.mesh.enabled"))
+        self.mesh_devices = int(config.get("scheduler.jax.mesh.devices"))
+        self.mesh_layout = str(config.get("scheduler.jax.mesh.layout"))
+        self._mesh: Any = _MESH_UNSET
         self.plan: dict[Key, str] = {}
         self.plans_computed = 0
         self.plan_hits = 0
@@ -327,6 +380,39 @@ class JaxPlacement:
         self.plan_parks += 1
         return "park", ws
 
+    def _get_mesh(self, build: bool = False):
+        """The engine mesh when the mesh path is enabled; ``None``
+        means off, unavailable, or not built yet.
+
+        Building touches jax backend init (and the jax-availability
+        probe, up to 20 s on a wedged accelerator tunnel), so it only
+        happens with ``build=True`` — which the plan path passes OFF
+        the event loop (the daemon planner thread; sync mode builds
+        inline, it is the explicit run-on-loop mode for tests).  Until
+        the first async plan lands the mesh, on-loop snapshots see
+        ``None`` and that plan runs with a replicated fleet upload —
+        the mirror's sharded view joins from the second plan on."""
+        if not self.mesh_enabled:
+            return None
+        if self._mesh is _MESH_UNSET:
+            if not build:
+                return None
+            from distributed_tpu.ops import partition as part
+
+            mesh = None
+            if part.jax_available():
+                try:
+                    mesh = part.make_engine_mesh(
+                        self.mesh_devices or None, self.mesh_layout
+                    )
+                except Exception:
+                    logger.exception(
+                        "engine mesh construction failed; "
+                        "falling back to the single-device engine"
+                    )
+            self._mesh = mesh
+        return self._mesh
+
     def _miss(self, ts: "TaskState", reason: str):
         self.plan.pop(ts.key, None)
         self.plan_misses += 1
@@ -425,13 +511,15 @@ class JaxPlacement:
             loop = None
         if loop is None:
             try:
-                plan = self._plan_from_arrays(*snapshot)
+                plan, engine_shards = self._plan_from_arrays(*snapshot)
             except Exception:
                 logger.exception(
                     "device planning failed; disabling co-processor"
                 )
                 self.enabled = False
                 return 0
+            if engine_shards:
+                state.observe_engine_shards(engine_shards)
             self.plan.update(plan)
             self.plans_computed += 1
             return len(plan)
@@ -453,7 +541,7 @@ class JaxPlacement:
             except BaseException as exc:
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                     raise
-                plan = None
+                plan = None, None
                 # a future cancelled by close() is a clean shutdown, not
                 # a planning failure
                 if not f.cancelled():
@@ -478,13 +566,15 @@ class JaxPlacement:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
 
-    def _merge(self, plan: "dict[Key, tuple] | None",
-               state: "SchedulerState") -> None:
+    def _merge(self, plan_shards, state: "SchedulerState") -> None:
         """Land an async plan on the loop thread, keeping only hints for
         tasks still pending — tasks the oracle placed while the plan was
         computing would otherwise accumulate as dead entries forever
         (and, with reused pure keys, serve stale hints to later graphs)."""
         self.plans_inflight -= 1
+        plan, engine_shards = plan_shards or (None, None)
+        if engine_shards:
+            state.observe_engine_shards(engine_shards)
         if plan:
             live = {
                 k: v
@@ -565,18 +655,37 @@ class JaxPlacement:
                 [ws in state.running for ws in workers], bool
             )
             addrs = [ws.address for ws in workers]
+        # mesh plan path: grab the mirror's workers-axis device shards
+        # ON LOOP (cheap O(dirty) scatter) so the planner thread reads
+        # immutable jax arrays the kernel consumes with ZERO fleet H2D;
+        # the host copies above still seed the load carry and the
+        # uniform/wide decisions.  Building the mesh is jax backend
+        # init — on-loop only in sync mode; the async path builds it in
+        # the planner thread on its first plan (_plan_from_arrays).
+        mesh = self._get_mesh(build=self.sync)
+        fleet_dev = None
+        if mesh is not None and mirror is not None:
+            try:
+                fleet_dev = mirror.sharded_device_view(mesh)
+            except Exception:
+                logger.exception(
+                    "sharded mirror view failed; replicated fleet upload"
+                )
         return (
             keys, durations, out_bytes,
             np.asarray(src, np.int32), np.asarray(dst, np.int32),
             nthreads, occupancy, running, addrs, state.bandwidth,
-            state.transfer_latency,
+            state.transfer_latency, mesh, fleet_dev,
         )
 
-    @staticmethod
-    def _plan_from_arrays(keys, durations, out_bytes, src, dst, nthreads,
-                          occupancy, running, addrs, bandwidth,
-                          transfer_latency=0.0):
-        """Plan on pure arrays — safe to run off-loop.
+    def _plan_from_arrays(self, keys, durations, out_bytes, src, dst,
+                          nthreads, occupancy, running, addrs, bandwidth,
+                          transfer_latency=0.0, mesh=None, fleet_dev=None):
+        """Plan on pure arrays — safe to run off-loop (the only ``self``
+        use is the one-time mesh build, deliberately placed HERE so jax
+        backend init happens on the planner thread).  Returns
+        ``(plan, engine_shards)`` where ``engine_shards`` is the sharded
+        engine's per-shard stat list (None off the mesh path).
 
         Two device engines compose here (ops/partition.py docstring has
         the measurements):
@@ -642,17 +751,42 @@ class JaxPlacement:
             return {
                 key: (None, addrs[lanes[int(labels[i])]])
                 for i, key in enumerate(keys)
-            }
+            }, None
 
         from distributed_tpu.ops.leveled import place_graph_streamed
 
         # streamed driver: on large graphs the pack fill and H2D upload
         # pipeline, so the plan lands one wire-crossing sooner (falls
-        # back to pack+place below the streaming threshold)
-        packed, result = place_graph_streamed(
-            durations, out_bytes, src, dst, nthreads, occupancy, running,
-            bandwidth=bandwidth, latency=transfer_latency,
-        )
+        # back to pack+place below the streaming threshold).  With a
+        # mesh the same driver dispatches through the SHARDED engine —
+        # per-shard H2D tiles, mirror-resident fleet rows — and any
+        # failure there degrades to the single-device program (the
+        # python oracle stays the final fallback at consume time).
+        engine_stats: dict | None = None
+        packed = result = None
+        if mesh is None:
+            # first async plan with the mesh path on: build it here,
+            # off the event loop (no-op when the path is disabled)
+            mesh = self._get_mesh(build=True)
+        if mesh is not None:
+            engine_stats = {}
+            try:
+                packed, result = place_graph_streamed(
+                    durations, out_bytes, src, dst, nthreads, occupancy,
+                    running, bandwidth=bandwidth, latency=transfer_latency,
+                    mesh=mesh, fleet_dev=fleet_dev, stats=engine_stats,
+                )
+            except Exception:
+                logger.exception(
+                    "sharded engine failed; single-device fallback"
+                )
+                engine_stats = None
+                packed = result = None
+        if result is None:
+            packed, result = place_graph_streamed(
+                durations, out_bytes, src, dst, nthreads, occupancy,
+                running, bandwidth=bandwidth, latency=transfer_latency,
+            )
         assignment = result.assignment
         nw = len(addrs)
         n = len(keys)
@@ -673,7 +807,7 @@ class JaxPlacement:
             )
             for i, key in enumerate(keys)
             if 0 <= assignment[i] < nw
-        }
+        }, (engine_stats or {}).get("shards")
 
     def __repr__(self) -> str:
         return (

@@ -154,6 +154,19 @@ class SchedulerMirror:
         # device cache: field name -> jax array (capacity-sized)
         self._dev: dict[str, Any] = {}
         self._dev_cap = -1
+        # SHARDED device cache (the mesh plan path): field name ->
+        # jax array placed with NamedSharding over the engine mesh's
+        # "workers" axis.  Slot s lives on shard s // (cap // n_shards)
+        # — the block mapping NamedSharding uses for dim 0 — so slot
+        # stability (tombstone LIFO reuse, no compaction) IS shard
+        # stability; only capacity growth remaps rows (counted as a
+        # full per-shard re-pack).  Separate dirty set: both the
+        # single-device and the sharded cache must observe every row
+        # change regardless of which consumer synced last.
+        self._sdev: dict[str, Any] = {}
+        self._sdev_mesh: Any | None = None
+        self._sdev_cap = -1
+        self._sdev_dirty: set[int] = set()
         # ------------------------------------------------ counters
         # (exposed through diagnostics/metrics; asserted by tests)
         self.generation = 0          # bumps when a refresh changed rows
@@ -169,6 +182,13 @@ class SchedulerMirror:
         #: incremented by consumers that fell back to the from-scratch
         #: Python pack while this mirror exists — 0 on the hot path
         self.oracle_packs = 0
+        # ---------------------------------------- per-shard counters
+        # (sharded_device_view; dtpu_mirror_shard_* at /metrics): a
+        # fresh cycle must show ZERO rows uploaded on EVERY shard, and
+        # full_packs must not creep past growth events
+        self.shard_rows_uploaded: list[int] = []
+        self.shard_bytes_uploaded: list[int] = []
+        self.shard_full_packs: list[int] = []
 
     # ------------------------------------------------------- allocation
 
@@ -190,9 +210,12 @@ class SchedulerMirror:
         lp[: self.cap] = self._live_pos
         self._live_pos = lp
         self.cap = new_cap
-        # shapes changed: the device cache must be rebuilt wholesale
+        # shapes changed: the device caches must be rebuilt wholesale
+        # (growth also remaps slot->shard: rows_per_shard doubled)
         self._dev.clear()
         self._device_dirty.clear()
+        self._sdev.clear()
+        self._sdev_dirty.clear()
 
     # ---------------------------------------------------- delta sources
 
@@ -265,6 +288,7 @@ class SchedulerMirror:
                 self.idle[slot] = is_running and ws.address in idle
                 self.status[slot] = STATUS_CODES.get(ws.status, STATUS_UNKNOWN)
         self._device_dirty.update(self._dirty)
+        self._sdev_dirty.update(self._dirty)
         self._dirty.clear()
         self.rows_refreshed += n
         self.generation += 1
@@ -369,6 +393,114 @@ class SchedulerMirror:
             )
         self._device_dirty.clear()
         return {f: self._dev[f] for f in fields}
+
+    def sharded_device_view(
+        self,
+        mesh,
+        fields: tuple[str, ...] = ("nthreads", "occupancy", "running"),
+    ) -> dict[str, Any] | None:
+        """Mesh-sharded fleet arrays for the SHARDED placement engine
+        (ops/leveled.place_graph_leveled_sharded): capacity-sized jax
+        arrays placed with ``NamedSharding(mesh, P("workers"))`` — each
+        device of the engine mesh holds exactly its block of slot rows.
+
+        Upload cost per call mirrors :meth:`device_view`, but accounted
+        PER SHARD: nothing when no row changed since the last sharded
+        sync (a fresh cycle ships zero fleet rows on every shard —
+        counter-asserted by the bench smoke gate), an O(dirty) scatter
+        grouped by owning shard otherwise, and a full per-shard pack
+        only at first use, capacity growth or a mesh change.  Returns
+        ``None`` when jax is unavailable or the mesh cannot divide the
+        capacity (callers fall back to replicated host arrays).
+        """
+        self.refresh()
+        try:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+        except Exception:  # pragma: no cover - no-jax hosts
+            return None
+        try:
+            n_shards = int(mesh.shape["workers"])
+        except (KeyError, TypeError):
+            return None
+        if n_shards <= 0 or self.cap % n_shards != 0:
+            # pow2 capacity x pow2 workers-axis in practice; a mesh that
+            # cannot divide the slot space gets the replicated fallback
+            return None
+        sharding = NamedSharding(mesh, P("workers"))
+        if len(self.shard_rows_uploaded) != n_shards:
+            # first sharded view, or a DIFFERENT mesh shape: the label
+            # space changed, so the counter vectors restart
+            self.shard_rows_uploaded = [0] * n_shards
+            self.shard_bytes_uploaded = [0] * n_shards
+            self.shard_full_packs = [0] * n_shards
+        if self._sdev_cap != self.cap or self._sdev_mesh != mesh:
+            # capacity growth or mesh swap: arrays rebuild wholesale
+            # below (counters keep accumulating — they are monotonic).
+            # Mesh EQUALITY, not identity: a caller rebuilding an equal
+            # mesh per cycle must not trigger a re-pack per plan.
+            self._sdev.clear()
+            self._sdev_cap = self.cap
+            self._sdev_mesh = mesh
+        rows_per_shard = self.cap // n_shards
+        if self._sdev_dirty and self._sdev:
+            # per-shard dirty-row scatter: group the dirty slots by
+            # owning shard and ship each shard ONLY its rows (pow2-
+            # padded with a repeated real row to bound jit-shape churn)
+            by_shard: dict[int, list[int]] = {}
+            for slot in self._sdev_dirty:
+                by_shard.setdefault(slot // rows_per_shard, []).append(slot)
+            for shard_i, slots in sorted(by_shard.items()):
+                n_changed = len(slots)
+                rows = np.asarray(slots, np.int32)
+                pad = _bucket(n_changed)
+                if pad > n_changed:
+                    rows = np.concatenate(
+                        [rows, np.full(pad - n_changed, rows[0], np.int32)]
+                    )
+                rows_j = jnp.asarray(rows)
+                for name in self._sdev:
+                    host = getattr(self, name)
+                    vals = host[rows]
+                    self._sdev[name] = self._sdev[name].at[rows_j].set(
+                        jnp.asarray(vals)
+                    )
+                    self.shard_bytes_uploaded[shard_i] += int(vals.nbytes)
+                self.shard_rows_uploaded[shard_i] += n_changed
+            self.rows_uploaded += len(self._sdev_dirty)
+            self.state.trace.emit(
+                "kernel", "mirror-upload", "", n=len(self._sdev_dirty),
+                dest="shard-scatter",
+            )
+        missing = [f for f in fields if f not in self._sdev]
+        if missing:
+            # first use of a field / growth / mesh change: one full
+            # sharded device_put — every shard receives its whole block
+            for name in missing:
+                self._sdev[name] = jax.device_put(
+                    getattr(self, name), sharding
+                )
+            for shard_i in range(n_shards):
+                self.shard_full_packs[shard_i] += 1
+            self.full_uploads += 1
+            self.state.trace.emit(
+                "kernel", "mirror-upload", "", n=self.cap,
+                dest="shard-full",
+            )
+        self._sdev_dirty.clear()
+        return {f: self._sdev[f] for f in fields}
+
+    def sharded_stats(self) -> dict[str, Any]:
+        """Per-shard upload counters (empty lists before the first
+        :meth:`sharded_device_view`); one list entry per ``workers``-
+        axis shard of the engine mesh."""
+        return {
+            "n_shards": len(self.shard_rows_uploaded),
+            "rows_uploaded": list(self.shard_rows_uploaded),
+            "bytes_uploaded": list(self.shard_bytes_uploaded),
+            "full_packs": list(self.shard_full_packs),
+        }
 
     # ----------------------------------------------------------- oracle
 

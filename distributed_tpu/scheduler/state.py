@@ -442,6 +442,12 @@ class SchedulerState:
         # messages folded per coalesced egress envelope (server-side
         # observe site: Scheduler.stream_payload_flush)
         self.hist_egress = Histogram(SIZE_BUCKETS)
+        # per-shard telemetry of the SHARDED placement engine (mesh
+        # plan path, scheduler/jax_placement.py): one entry per mesh
+        # shard — last plan's kernel completion ms, cumulative H2D
+        # bytes, plans counted.  Exposed as dtpu_engine_shard_* at
+        # /metrics; empty until a sharded plan ran.
+        self.engine_shards: list[dict] = []
         # measured-truth telemetry plane (telemetry.py): fleet link
         # EWMAs/t-digests folded from worker heartbeats, task-prefix
         # priors, and the shadow cost-model divergence monitor.
@@ -1655,6 +1661,21 @@ class SchedulerState:
         return (start_time, ws.nbytes)
 
     # ------------------------------------------------------- placement
+
+    def observe_engine_shards(self, shards: list[dict]) -> None:
+        """Fold one sharded plan's per-shard stats (from
+        ``ops/leveled.place_graph_leveled_sharded``) into the
+        /metrics-facing aggregates: kernel ms is last-plan, H2D bytes
+        and plan count accumulate."""
+        if len(self.engine_shards) != len(shards):
+            self.engine_shards = [
+                {"kernel_ms": 0.0, "h2d_bytes": 0, "plans": 0}
+                for _ in shards
+            ]
+        for agg, s in zip(self.engine_shards, shards):
+            agg["kernel_ms"] = float(s.get("kernel_ms", 0.0))
+            agg["h2d_bytes"] += int(s.get("h2d_bytes", 0))
+            agg["plans"] += 1
 
     def is_rootish(self, ts: TaskState) -> bool:
         """Root-ish: a task in a large group with few deps

@@ -47,6 +47,23 @@ def test_bench_smoke_runs_and_reports():
     assert stats["oracle_failures"] == 0
     assert stats["full_uploads"] <= 1
     assert stats["rows_uploaded"] == 0
+    # sharded engine gate (ops/leveled.place_graph_leveled_sharded on
+    # the 8-device CPU mesh): the 1x1 mesh is the identity refactor,
+    # the full mesh agrees with the single-device engine, the mirror's
+    # workers-axis shards fed the kernel, and a fresh second cycle
+    # shipped ZERO fleet rows on every shard with no wholesale re-pack
+    # (the bench half raises on any violation; these asserts pin the
+    # contract in the gate's own output)
+    mesh = out["configs"]["mesh"]
+    assert mesh["identity_1x1"] is True
+    assert mesh["agreement"] > 0.97
+    assert mesh["n_workers"] > 0
+    assert len(mesh["engine_shards"]) >= 2
+    assert all(r["h2d_bytes"] > 0 for r in mesh["engine_shards"])
+    ms = mesh["mirror_shards"]
+    assert ms["n_shards"] >= 2
+    assert all(r == 0 for r in ms["rows_uploaded"])
+    assert all(f == 1 for f in ms["full_packs"])
     # zero-copy wire contract (protocol/buffers.py, docs/wire.md): tcp
     # round trips at 1 KB / 64 KB / 8 MB recorded NO payload copy on
     # the send path and the receive pool saw reuse

@@ -63,7 +63,7 @@ async def test_async_plan_lands_mid_execution():
     # layer was already oracle-placed and plan_hits would read 0)
     import numpy as np
 
-    JaxPlacement._plan_from_arrays(
+    placement._plan_from_arrays(
         [f"warm{i}" for i in range(8)],
         np.ones(8, np.float32), np.full(8, 1e6, np.float32),
         np.arange(4, dtype=np.int32), np.arange(4, 8, dtype=np.int32),

@@ -225,6 +225,35 @@ def test_streamed_fallback_below_threshold():
 
 
 @needs_native
+def test_streamed_sharded_1x1_is_identity_refactor():
+    """The streamed driver's SHARDED branch on a 1x1 mesh must be
+    bit-identical to the single-device streamed driver (compact=False):
+    same topo, same waves, same kernel math — the mesh is pure
+    transport there (ops/leveled sharded engine; tests/
+    test_sharded_engine.py covers multi-device meshes)."""
+    from distributed_tpu.ops.partition import make_engine_mesh
+
+    rng = np.random.default_rng(31)
+    durations, out_bytes, src, dst = random_dag(rng, 30_000)
+    nthreads, occ0, running = workers(16)
+    _, res0 = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BW, compact=False, chunk_rows=7_000, min_stream=1,
+    )
+    mesh = make_engine_mesh(layout="1x1")
+    tm: dict = {}
+    _, res1 = place_graph_streamed(
+        durations, out_bytes, src, dst, nthreads, occ0, running,
+        bandwidth=BW, chunk_rows=7_000, min_stream=1, mesh=mesh,
+        timings=tm,
+    )
+    assert tm["fmt"] == "f16"
+    np.testing.assert_array_equal(res1.assignment, res0.assignment)
+    np.testing.assert_array_equal(res1.choice, res0.choice)
+    np.testing.assert_array_equal(res1.occupancy, res0.occupancy)
+
+
+@needs_native
 def test_streamed_cycle_raises():
     src = np.array([0, 1, 2], np.int32)
     dst = np.array([1, 2, 0], np.int32)

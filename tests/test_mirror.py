@@ -264,6 +264,84 @@ def test_fresh_mirror_cycle_no_pack_no_upload():
     assert float(m.occupancy[ws.idx]) == np.float32(ws.occupancy)
 
 
+def test_sharded_device_view_per_shard_scatter_and_growth():
+    """Mesh-sharded fleet arrays (sharded_device_view): per-shard
+    dirty-row accounting, zero rows on fresh cycles, values in lockstep
+    with the host SoA, full per-shard re-pack only on capacity growth
+    (growth remaps slot->shard, so nothing cheaper is sound)."""
+    import numpy as np
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from distributed_tpu.ops.partition import make_engine_mesh
+
+    mesh = make_engine_mesh(layout="4x2")  # workers axis: 2 shards
+    state = _state(n_workers=16, nthreads=2)
+    m = state.mirror
+    v = m.sharded_device_view(mesh)
+    assert v is not None
+    ss = m.sharded_stats()
+    assert ss["n_shards"] == 2
+    assert ss["full_packs"] == [1, 1]
+    assert ss["rows_uploaded"] == [0, 0]
+    # fresh second view: nothing moves on any shard
+    m.sharded_device_view(mesh)
+    assert m.sharded_stats()["rows_uploaded"] == [0, 0]
+    # dirty one worker per shard half; only the owning shard scatters
+    rows_per_shard = m.cap // 2
+    ws_lo = next(ws for ws in state.workers.values()
+                 if ws.idx < rows_per_shard)
+    state._adjust_occupancy(ws_lo, 2.5)
+    v = m.sharded_device_view(mesh)
+    ss = m.sharded_stats()
+    assert ss["rows_uploaded"] == [1, 0], ss
+    assert float(np.asarray(v["occupancy"])[ws_lo.idx]) == np.float32(
+        ws_lo.occupancy
+    )
+    ws_hi = next(ws for ws in state.workers.values()
+                 if ws.idx >= rows_per_shard)
+    state.set_worker_nthreads(ws_hi, 4)
+    v = m.sharded_device_view(mesh)
+    ss = m.sharded_stats()
+    assert ss["rows_uploaded"] == [1, 1], ss
+    assert int(np.asarray(v["nthreads"])[ws_hi.idx]) == 4
+    # growth: capacity doubles, slot->shard remaps, shards re-pack once
+    for i in range(m.cap):  # force at least one _grow
+        state.add_worker_state(
+            f"tcp://127.0.0.1:{20000 + i}", nthreads=1,
+            memory_limit=2**30, name=f"g{i}",
+        )
+    v = m.sharded_device_view(mesh)
+    ss = m.sharded_stats()
+    assert ss["full_packs"] == [2, 2], ss
+    # ...and values still match the host SoA everywhere
+    for name in ("nthreads", "occupancy", "running"):
+        np.testing.assert_array_equal(
+            np.asarray(v[name]), getattr(m, name)
+        )
+    m.verify()
+
+
+def test_sharded_device_view_indivisible_mesh_returns_none():
+    """A mesh whose workers axis cannot divide the slot capacity gets
+    the replicated fallback (None), never a crash."""
+    import jax
+
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >= 3 devices")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    state = _state(n_workers=4)
+    mesh = Mesh(
+        np.asarray(jax.devices()[:3]).reshape(1, 3),
+        axis_names=("tasks", "workers"),
+    )
+    assert state.mirror.sharded_device_view(mesh) is None
+
+
 def test_shared_fleet_view_feeds_steal_and_amm_without_repack():
     """One dirty flush serves a whole cycle: steal + AMM both consume
     the mirror with zero additional refreshes and zero Python packs."""

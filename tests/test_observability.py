@@ -1010,6 +1010,20 @@ def test_metrics_names_unique_and_documented():
         ["execute", "", "inc", "count", "tasks", 2],
     ])
     tel.observe_divergence(1.0, 0.1, True)
+    # seed the sharded-engine + sharded-mirror families (the mesh plan
+    # path, PR 8): a real sharded_device_view over the conftest CPU
+    # mesh populates the per-shard mirror counters, and one folded
+    # engine-shard stat row populates dtpu_engine_shard_*
+    _Sched.state.add_worker_state(
+        "tcp://pm:9", nthreads=1, memory_limit=2**30, name="pm9"
+    )
+    from distributed_tpu.ops.partition import make_engine_mesh
+
+    _Sched.state.mirror.sharded_device_view(make_engine_mesh(layout="4x2"))
+    _Sched.state.observe_engine_shards(
+        [{"shard": 0, "kernel_ms": 0.5, "h2d_bytes": 1024},
+         {"shard": 1, "kernel_ms": 0.6, "h2d_bytes": 1024}]
+    )
 
     class _SpillDict(dict):  # enables the spill metric lines
         spilled_count = 0
@@ -1073,7 +1087,12 @@ def test_metrics_names_unique_and_documented():
             "dtpu_costmodel_divergence_ratio_sum",
             "dtpu_costmodel_divergence_ratio_count",
             "dtpu_costmodel_shadow_evals_total",
-            "dtpu_costmodel_shadow_measured_total"} <= all_names
+            "dtpu_costmodel_shadow_measured_total",
+            "dtpu_mirror_shard_rows_uploaded_total",
+            "dtpu_mirror_shard_bytes_uploaded_total",
+            "dtpu_mirror_shard_full_packs_total",
+            "dtpu_engine_shard_kernel_ms",
+            "dtpu_engine_shard_h2d_bytes_total"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
         f"metrics missing from the docs/observability.md table: "
