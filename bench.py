@@ -56,6 +56,12 @@ CONFIGS = [
     # per-shard H2D/collective structure is what is measured; the box
     # has no multi-chip accelerator)
     ("dag_10m", 900.0, True),
+    # sans-io cluster simulator headline (distributed_tpu/sim): 1M tasks
+    # through the REAL scheduler engine + 10,000 REAL worker state
+    # machines on a virtual clock, run twice — the virtual makespan and
+    # whole-run digest must be bit-identical, so the reported number is
+    # immune to the box's 2x wall drift
+    ("sim_10k", 7200.0, True),
 ]
 
 
@@ -960,6 +966,71 @@ def cfg_dag_10m():
     }
 
 
+def _sim_10k_once(seed: int):
+    """One 1M-task / 10k-virtual-worker run through the real engines on
+    the virtual clock; returns (report, digest)."""
+    from distributed_tpu.sim import ClusterSim, SyntheticDag
+
+    sim = ClusterSim(
+        10_000, nthreads=1, seed=seed, validate=False,
+        # per-link telemetry would build ~10^5 native t-digests at this
+        # fleet scale; the headline measures the engines, not telemetry
+        config_overrides={"scheduler.telemetry.enabled": False},
+    )
+    sim.install_digest()
+    trace = SyntheticDag(
+        n_layers=50, layer_width=20_000, fanin=2, seed=seed,
+        layers_per_chunk=2, n_roots=10_000,
+        # independent chunk-graphs: completed chunks FORGET, so
+        # resident TaskStates stay bounded at a few chunks instead of
+        # pinning the whole 1M chain (docs/simulator.md)
+        linked_chunks=False,
+    )
+    t0 = time.perf_counter()
+    trace.start(sim)
+    report = sim.run()
+    report["wall_s"] = round(time.perf_counter() - t0, 1)
+    report["n_tasks"] = trace.n_tasks
+    return report, sim.digest()
+
+
+def cfg_sim_10k():
+    """Simulator headline (ROADMAP item 1): place-and-run a 1M-task
+    layered graph on 10,000 REAL WorkerState machines + the REAL
+    scheduler engine with steal + AMM cycles, single process, virtual
+    clock — twice with the same seed.  The virtual makespan and the
+    whole-run transition digest must be BIT-IDENTICAL between the two
+    runs: the reported makespan is a pure function of workload + links
+    + policies, immune to the box's 2x wall drift."""
+    rep1, digest1 = _sim_10k_once(seed=0)
+    rep2, digest2 = _sim_10k_once(seed=0)
+    assert digest1 == digest2, (
+        f"sim_10k same-seed digests diverged: {digest1} vs {digest2}"
+    )
+    assert rep1["virtual_makespan_s"] == rep2["virtual_makespan_s"], (
+        rep1["virtual_makespan_s"], rep2["virtual_makespan_s"],
+    )
+    assert rep1["keys_done"] >= rep1["keys_wanted"] > 0, rep1
+    transitions = (
+        rep1["scheduler_transitions"] + rep1["worker_transitions"]
+    )
+    return {
+        "n_tasks": rep1["n_tasks"],
+        "n_workers": rep1["n_workers"],
+        "virtual_makespan_s": rep1["virtual_makespan_s"],
+        "wall_s": [rep1["wall_s"], rep2["wall_s"]],
+        "transitions": transitions,
+        "decisions_per_s": round(transitions / rep1["wall_s"]),
+        "steals": rep1["steals"],
+        "amm_cycles": rep1["counters"].get("amm_cycles", 0),
+        "steal_cycles": rep1["counters"].get("steal_cycles", 0),
+        "events": rep1["events"],
+        "digest": digest1,
+        "deterministic": True,
+        "host_canary_ms": _host_canary_ms(),
+    }
+
+
 # =====================================================================
 # smoke mode: seconds-scale, CPU-pinned miniatures of the live-path and
 # placement-path configs, run by a tier-1 test on every PR so the perf
@@ -1583,6 +1654,106 @@ def _smoke_telemetry() -> dict:
     return out
 
 
+def _smoke_sim() -> dict:
+    """Simulator gate (distributed_tpu/sim; docs/simulator.md): the
+    tier-1 miniature of ``sim_10k``.  Raises if
+
+    - two same-seed runs (48 virtual workers, ~1k tasks, steal + AMM
+      cycles live) do not produce BIT-IDENTICAL whole-run digests,
+      transition-stream digests, and virtual makespans — the
+      determinism contract every sim-based perf gate rests on;
+    - a worker-death chaos run loses a key or leaves the replica model
+      disagreeing with the fleet;
+    - a journal recorded from a simulated run does not replay through
+      the batched engine to the identical transition stream (the
+      sim <-> live replay-format contract, docs/observability.md).
+    """
+    from distributed_tpu.diagnostics.flight_recorder import (
+        replay_stimulus_trace,
+        transition_stream,
+    )
+    from distributed_tpu.sim import ClusterSim, SyntheticDag
+    from distributed_tpu.sim.chaos import scenario_worker_death
+    from distributed_tpu.sim.validate import check_no_lost_keys
+
+    N_WORKERS, LAYERS, WIDTH = 48, 12, 90
+
+    def build(run_periodics=True, layers=LAYERS, chunk=3):
+        sim = ClusterSim(
+            N_WORKERS, seed=0, validate=True,
+            steal_interval=None if run_periodics else 0,
+            amm_interval=None if run_periodics else 0,
+            find_missing_interval=1.0 if run_periodics else 0,
+        )
+        sim.install_digest()
+        trace = SyntheticDag(
+            n_layers=layers, layer_width=WIDTH, fanin=2, seed=0,
+            layers_per_chunk=chunk,
+        )
+        return sim, trace
+
+    t0 = time.perf_counter()
+    sim1, tr1 = build()
+    tr1.start(sim1)
+    rep1 = sim1.run()
+    wall = time.perf_counter() - t0
+    check_no_lost_keys(sim1)
+    sim2, tr2 = build()
+    tr2.start(sim2)
+    rep2 = sim2.run()
+    check_no_lost_keys(sim2)
+    assert sim1.digest() == sim2.digest(), (
+        f"same-seed sim digests diverged: {sim1.digest()} {sim2.digest()}"
+    )
+    assert rep1["virtual_makespan_s"] == rep2["virtual_makespan_s"], (
+        rep1["virtual_makespan_s"], rep2["virtual_makespan_s"],
+    )
+
+    # chaos mini: deterministic worker death converges with no lost keys
+    _csim, crep = scenario_worker_death(seed=1, n_workers=12)
+    assert crep["keys_done"] >= crep["keys_wanted"], crep
+
+    # record -> replay parity: a sim-captured stimulus journal re-fed
+    # through the batched engine reproduces the identical stream.
+    # Single-chunk workload: the journal records ENGINE stimuli, so the
+    # replay state must be structurally identical up front — chunked
+    # submission materializes tasks mid-run, outside the contract
+    # (docs/observability.md "replayable stimulus-trace format")
+    rsim, rtrace = build(run_periodics=False, layers=5, chunk=5)
+    rtrace.start(rsim)
+    mark = len(rsim.state.transition_log)
+    rsim.journal_start()
+    rsim.run()
+    records = rsim.journal()
+    assert records, "sim journal captured nothing"
+    psim, ptrace = build(run_periodics=False, layers=5, chunk=5)
+    ptrace.start(psim)
+    mark_p = len(psim.state.transition_log)
+    replay_stimulus_trace(psim.state, records)
+    recorded = transition_stream(rsim.state, mark)
+    replayed = transition_stream(psim.state, mark_p)
+    assert recorded == replayed, (
+        f"sim journal replay diverged ({len(recorded)} vs "
+        f"{len(replayed)} rows)"
+    )
+
+    transitions = rep1["scheduler_transitions"] + rep1["worker_transitions"]
+    return {
+        "n_workers": N_WORKERS,
+        "n_tasks": LAYERS * WIDTH,
+        "virtual_makespan_s": rep1["virtual_makespan_s"],
+        "wall_s": round(wall, 2),
+        "transitions": transitions,
+        "decisions_per_s": round(transitions / wall),
+        "steals": rep1["steals"],
+        "digest": sim1.digest(),
+        "deterministic": True,
+        "chaos_death_lost": crep["keys_lost"],
+        "replay_match": True,
+        "replay_rows": len(recorded),
+    }
+
+
 def run_smoke():
     """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
     line on stdout; raises (non-zero exit) on any failure."""
@@ -1611,6 +1782,7 @@ def run_smoke():
         "wire": asyncio.run(_smoke_wire()),
         "trace": retry_once(_smoke_trace),
         "telemetry": retry_once(_smoke_telemetry),
+        "sim": _smoke_sim(),
         # LAST on purpose: the sharded programs spin up the 8-device
         # XLA runtime (one thread pool per virtual device on a 2-core
         # box) and that background churn measurably widens the
@@ -1650,6 +1822,8 @@ def run_config(name, force_cpu=False):
         result = cfg_dag_1m()
     elif name == "dag_10m":
         result = cfg_dag_10m()
+    elif name == "sim_10k":
+        result = cfg_sim_10k()
     else:
         import asyncio
 

@@ -106,11 +106,12 @@ defaults: dict[str, Any] = {
             # mirror (ops/leveled.place_graph_leveled_sharded,
             # scheduler/mirror.sharded_device_view): one placement
             # cycle runs as a single partitioned XLA program over N
-            # devices.  Off by default — a one-device host pays pure
-            # collective overhead; enable on multi-chip (or the
-            # 8-device CPU mesh in tests/bench).
+            # devices.  "auto" (default) turns it on iff more than one
+            # device is visible at mesh-build time — a one-device host
+            # pays pure collective overhead and keeps the single-device
+            # -> python fallback chain; explicit true/false force it.
             "mesh": {
-                "enabled": False,
+                "enabled": "auto",
                 # devices to put in the mesh; 0 = all visible
                 "devices": 0,
                 # "auto" (near-square factoring, workers axis the

@@ -286,6 +286,41 @@ def replay_stimulus_trace(state: Any, records: Iterable[dict],
                 payload.get("key", ""), payload.get("worker", ""),
                 rec.get("stim", ""),
             )
+        elif op == "add-keys":
+            # replica registration also mutates who_has outside the
+            # engine, and later placements READ it: a journal without
+            # these records replays dependency graphs with drifting
+            # placements (the simulator's parity test catches it)
+            flush()
+            merge(*state.stimulus_add_keys(
+                payload.get("keys") or (), payload.get("worker", ""),
+                rec.get("stim", ""),
+            ))
+        elif op == "long-running":
+            flush()
+            merge(*state.stimulus_long_running(
+                payload.get("key", ""), payload.get("worker", ""),
+                float(payload.get("compute_duration") or 0.0),
+                rec.get("stim", ""),
+            ))
+        elif op == "reschedule":
+            flush()
+            merge(*state.stimulus_reschedule(
+                payload.get("key", ""), payload.get("worker", ""),
+                rec.get("stim", ""),
+            ))
+        elif op == "missing-data":
+            flush()
+            merge(*state.stimulus_missing_data(
+                payload.get("key", ""), payload.get("errant_worker", ""),
+                rec.get("stim", ""),
+            ))
+        elif op == "remove-worker":
+            flush()
+            merge(*state.remove_worker_state(
+                payload.get("worker", ""), stimulus_id=rec.get("stim", ""),
+                safe=bool(payload.get("safe", False)),
+            ))
         elif op == "transitions":
             flush()
             merge(

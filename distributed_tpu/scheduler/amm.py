@@ -73,6 +73,10 @@ class ActiveMemoryManagerExtension:
             start = config.get("scheduler.active-memory-manager.start")
         if register and start:
             scheduler.periodic_callbacks["amm"] = self._pc
+        # injectable stimulus-id mint (ROADMAP item 1 simulator):
+        # seq_name is a process-global counter, so the sim swaps in a
+        # per-run deterministic mint to keep same-seed digests identical
+        self.seq = seq_name
         # round-local bookkeeping (reference amm.py:58-66)
         self.pending: dict = {}
         self.workers_memory: dict = {}
@@ -102,7 +106,7 @@ class ActiveMemoryManagerExtension:
     # ------------------------------------------------------------ one round
 
     def run_once(self) -> None:
-        stimulus_id = seq_name("amm")
+        stimulus_id = self.seq("amm")
         # projected memory per worker for this round: actual managed
         # bytes plus/minus the round's own decisions (reference
         # amm.py:~200).  Kept as an OVERLAY over live ``ws.nbytes``

@@ -188,7 +188,15 @@ class JaxPlacement:
         # program over the mesh and the fleet half comes from the
         # mirror's workers-axis shards; any failure falls back to the
         # single-device engine, which falls back to the python oracle.
-        self.mesh_enabled = bool(config.get("scheduler.jax.mesh.enabled"))
+        # "auto" (the default, ROADMAP item 2 leftover): the sharded
+        # engine turns on iff MORE THAN ONE device is visible at
+        # mesh-build time — a single-device host pays pure collective
+        # overhead, so it keeps the single-device -> python fallback
+        # chain.  Explicit booleans force it either way.
+        mesh_cfg = config.get("scheduler.jax.mesh.enabled")
+        self.mesh_enabled: bool | None = (
+            mesh_cfg if isinstance(mesh_cfg, bool) else None
+        )
         self.mesh_devices = int(config.get("scheduler.jax.mesh.devices"))
         self.mesh_layout = str(config.get("scheduler.jax.mesh.layout"))
         self._mesh: Any = _MESH_UNSET
@@ -392,7 +400,7 @@ class JaxPlacement:
         the first async plan lands the mesh, on-loop snapshots see
         ``None`` and that plan runs with a replicated fleet upload —
         the mirror's sharded view joins from the second plan on."""
-        if not self.mesh_enabled:
+        if self.mesh_enabled is False:
             return None
         if self._mesh is _MESH_UNSET:
             if not build:
@@ -402,9 +410,15 @@ class JaxPlacement:
             mesh = None
             if part.jax_available():
                 try:
-                    mesh = part.make_engine_mesh(
-                        self.mesh_devices or None, self.mesh_layout
-                    )
+                    if self.mesh_enabled is None and self._n_visible() < 2:
+                        # auto mode on a 1-device host: stay on the
+                        # single-device engine (tested: a 1x1 mesh is
+                        # bit-identical but pays dispatch overhead)
+                        mesh = None
+                    else:
+                        mesh = part.make_engine_mesh(
+                            self.mesh_devices or None, self.mesh_layout
+                        )
                 except Exception:
                     logger.exception(
                         "engine mesh construction failed; "
@@ -412,6 +426,17 @@ class JaxPlacement:
                     )
             self._mesh = mesh
         return self._mesh
+
+    @staticmethod
+    def _n_visible() -> int:
+        """Visible jax device count (0 on import failure) — only called
+        behind a successful ``jax_available()`` probe."""
+        try:
+            import jax
+
+            return len(jax.devices())
+        except Exception:
+            return 0
 
     def _miss(self, ts: "TaskState", reason: str):
         self.plan.pop(ts.key, None)

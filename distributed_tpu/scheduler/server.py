@@ -921,83 +921,52 @@ class Scheduler(Server):
             )
             self.send_all(client_msgs, worker_msgs)
 
+    # the pure bodies of these scalar worker-op handlers live on
+    # SchedulerState (stimulus_add_keys & co): the sans-io cluster
+    # simulator (distributed_tpu/sim) drives the same implementations
+    # directly, so the live stream plane and the simulated one cannot
+    # drift apart.
+
     def handle_add_keys(self, keys: Iterable[Key] = (), worker: str = "",
                         stimulus_id: str = "", **kwargs: Any) -> None:
         """Worker acquired replicas out-of-band (reference scheduler.py:5855)."""
-        ws = self.state.workers.get(worker)
-        if ws is None:
-            return
-        redundant = []
-        for key in keys:
-            ts = self.state.tasks.get(key)
-            if ts is not None and ts.state == "memory":
-                self.state.add_replica(ts, ws)
-            else:
-                redundant.append(key)
-        if redundant:
-            self.send_all({}, {worker: [{
-                "op": "remove-replicas", "keys": redundant,
-                "stimulus_id": stimulus_id or seq_name("add-keys"),
-            }]})
+        client_msgs, worker_msgs = self.state.stimulus_add_keys(
+            keys, worker, stimulus_id or seq_name("add-keys")
+        )
+        self.send_all(client_msgs, worker_msgs)
 
     def handle_long_running(self, key: Key = "", worker: str = "",
                             compute_duration: float = 0.0,
                             stimulus_id: str = "", **kwargs: Any) -> None:
         """Task seceded from its thread slot (reference scheduler.py:5906)."""
-        ts = self.state.tasks.get(key)
-        if ts is None or ts.processing_on is None:
-            return
-        ws = ts.processing_on
-        if ws.address != worker:
-            return
-        occ = ws.processing.get(ts)
-        if occ is not None:
-            self.state._adjust_occupancy(ws, -occ)
-            # graft-lint: allow[mirror-parity] row marked by the _adjust_occupancy above and the check_idle_saturated below
-            ws.processing[ts] = 0.0
-        ws.long_running.add(ts)
-        self.state.check_idle_saturated(ws)
+        client_msgs, worker_msgs = self.state.stimulus_long_running(
+            key, worker, compute_duration,
+            stimulus_id or seq_name("long-running"),
+        )
+        self.send_all(client_msgs, worker_msgs)
 
     def handle_reschedule(self, key: Key = "", worker: str = "",
                           stimulus_id: str = "", **kwargs: Any) -> None:
-        ts = self.state.tasks.get(key)
-        if ts is None or ts.processing_on is None:
-            return
-        if ts.processing_on.address != worker:
-            return
-        client_msgs, worker_msgs = self.state.transitions(
-            {key: "released"}, stimulus_id or seq_name("reschedule")
+        client_msgs, worker_msgs = self.state.stimulus_reschedule(
+            key, worker, stimulus_id or seq_name("reschedule")
         )
         self.send_all(client_msgs, worker_msgs)
 
     def handle_missing_data(self, key: Key = "", errant_worker: str = "",
                             stimulus_id: str = "", **kwargs: Any) -> None:
         """A peer did not have data it was supposed to (reference :5869)."""
-        ts = self.state.tasks.get(key)
-        ws = self.state.workers.get(errant_worker)
-        if ts is None:
-            return
-        if ws is not None and ws in ts.who_has:
-            self.state.remove_replica(ts, ws)
-        if not ts.who_has:
-            client_msgs, worker_msgs = self.state.transitions(
-                {key: "released"}, stimulus_id or seq_name("missing-data")
-            )
-            self.send_all(client_msgs, worker_msgs)
+        client_msgs, worker_msgs = self.state.stimulus_missing_data(
+            key, errant_worker, stimulus_id or seq_name("missing-data")
+        )
+        self.send_all(client_msgs, worker_msgs)
 
     def handle_request_refresh_who_has(self, keys: Iterable[Key] = (),
                                        worker: str = "",
                                        stimulus_id: str = "", **kw: Any) -> None:
-        who_has = {}
-        for key in keys:
-            ts = self.state.tasks.get(key)
-            who_has[key] = (
-                [ws.address for ws in ts.who_has] if ts is not None else []
-            )
-        self.send_all({}, {worker: [{
-            "op": "refresh-who-has", "who_has": who_has,
-            "stimulus_id": stimulus_id or seq_name("refresh-who-has"),
-        }]})
+        client_msgs, worker_msgs = self.state.stimulus_request_refresh_who_has(
+            keys, worker, stimulus_id or seq_name("refresh-who-has")
+        )
+        self.send_all(client_msgs, worker_msgs)
 
     def handle_worker_log_event(self, topic: Any = None, msg: Any = None,
                                 worker: str = "", **kw: Any) -> None:

@@ -125,10 +125,10 @@ class Computation:
 
     __slots__ = ("start", "groups", "id")
 
-    def __init__(self):
+    def __init__(self, now: float | None = None):
         from distributed_tpu.utils.misc import seq_name
 
-        self.start = time()
+        self.start = now if now is not None else time()
         self.groups: set[TaskGroup] = set()
         self.id = seq_name("computation")
 
@@ -320,10 +320,10 @@ class ClientState:
 
     __slots__ = ("client_key", "wants_what", "last_seen", "versions")
 
-    def __init__(self, client: str):
+    def __init__(self, client: str, now: float | None = None):
         self.client_key = client
         self.wants_what: set[TaskState] = set()
-        self.last_seen = time()
+        self.last_seen = now if now is not None else time()
         self.versions: dict = {}
 
     def __repr__(self) -> str:
@@ -429,11 +429,20 @@ class SchedulerState:
         transition_counter_max: int | None = None,
         placement: Any | None = None,
         mirror: bool | None = None,
+        clock: Callable[[], float] | None = None,
     ):
+        # injectable clock (ROADMAP item 1 simulator): every timestamp
+        # this engine writes — transition-log rows, event stamps,
+        # no-worker parking, nthreads history — reads ``self.clock``.
+        # Default is the monotonic utils.misc.time; the sans-io cluster
+        # simulator (distributed_tpu/sim) passes its VirtualClock so a
+        # whole cluster's control plane runs on virtual seconds.
+        self.clock = clock if clock is not None else time
         # flight recorder + engine histograms (tracing.py;
         # docs/observability.md) — created FIRST: worker registration and
         # the mirror emit through them during the rest of this __init__
         self.trace = FlightRecorder()
+        self.trace.clock = self.clock
         # recommendations per engine pass / flood fold size
         self.hist_engine_batch = Histogram(SIZE_BUCKETS)
         # wall seconds per engine pass (one flood fold or one
@@ -455,6 +464,7 @@ class SchedulerState:
         # tested in tests/test_telemetry.py); ROADMAP item 3 swaps the
         # kernel inputs in a future PR.
         self.telemetry = ClusterTelemetry()
+        self.telemetry.clock = self.clock
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
         # one entry per update_graph batch (reference scheduler.py:864)
@@ -536,7 +546,7 @@ class SchedulerState:
         }
 
         self.total_nthreads = 0
-        self.total_nthreads_history: list[tuple[float, int]] = [(time(), 0)]
+        self.total_nthreads_history: list[tuple[float, int]] = [(self.clock(), 0)]
         self._total_occupancy = 0.0
         self.n_tasks = 0
         self.plugins: dict[str, Any] = {}
@@ -674,7 +684,7 @@ class SchedulerState:
 
         actual_finish = ts.state
         self.transition_log.append(
-            (key, start, actual_finish, dict(recommendations), stimulus_id, time())
+            (key, start, actual_finish, dict(recommendations), stimulus_id, self.clock())
         )
         # task-level trace hop (sampled 1-in-N): name=finish, dest=start
         # — interned strings only, so the flood fast path allocates
@@ -725,16 +735,27 @@ class SchedulerState:
             tr.record(
                 "transitions", {"recs": dict(recommendations)}, stimulus_id
             )
+        return self._transitions_observed(recommendations, stimulus_id)
+
+    def _transitions_observed(
+        self, recommendations: dict[Key, str], stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """One observed engine round WITHOUT a journal record: the drain
+        plus the histogram/trace-ring observations.  Journaled stimuli
+        that drive an engine round internally (reschedule,
+        missing-data) MUST use this — their own journal op replays the
+        round, so a nested ``transitions`` record would run it twice
+        on replay (the same rule release-worker-data documents)."""
         client_msgs: dict = {}
         worker_msgs: dict = {}
-        t0 = time()
+        t0 = self.clock()
         self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
         # histograms observe regardless of trace.enabled: dtpu_engine_*
         # are documented /metrics families, not trace output
         n = len(recommendations)
         self.hist_engine_batch.observe(n)
-        self.hist_engine_pass.observe(time() - t0)
-        tr.emit("engine", "transitions", stimulus_id, n=n)
+        self.hist_engine_pass.observe(self.clock() - t0)
+        self.trace.emit("engine", "transitions", stimulus_id, n=n)
         return client_msgs, worker_msgs
 
     def story(self, *keys_or_stimuli: Key) -> list[tuple]:
@@ -790,7 +811,7 @@ class SchedulerState:
             if self.workers:
                 recommendations[key] = "processing"
             else:
-                self.unrunnable[ts] = time()
+                self.unrunnable[ts] = self.clock()
                 ts.state = "no-worker"
                 self._count_transition(ts, "waiting", "no-worker")
         return recommendations, {}, {}
@@ -881,7 +902,22 @@ class SchedulerState:
             for dts in ts.dependencies:
                 dts.waiters.add(ts)
         else:
-            ts.waiters.clear()  # reference scheduler.py:2602
+            # not rerunning (reference scheduler.py:2602 clears waiters
+            # here).  A WAITING waiter at this point re-registered
+            # mid-cascade: an erred-retry hop (erred -> released ->
+            # waiting) can resurrect a dependent while our own
+            # "released" recommendation is still queued in the same
+            # drain — blindly clearing would leave it waiting on a dep
+            # that will never run (dangling waiting_on, a liveness
+            # hole; hash-order-dependent flake in the mirror churn
+            # trace, deterministically pinned by
+            # tests/test_races.py::test_waiting_released_reroutes_resurrected_waiters).
+            # Reroute it through released: its re-registration then
+            # sees our final "released" state and recommends our rerun.
+            for dts in ts.waiters:
+                if dts.state == "waiting":
+                    recommendations[dts.key] = "released"
+            ts.waiters.clear()
         return recommendations, {}, {}
 
     def _transition_waiting_queued(self, key: Key, stimulus_id: str) -> tuple[dict, dict, dict]:
@@ -905,7 +941,7 @@ class SchedulerState:
         ts = self.tasks[key]
         ts.state = "no-worker"
         self._count_transition(ts, "waiting", "no-worker")
-        self.unrunnable[ts] = time()
+        self.unrunnable[ts] = self.clock()
         return {}, {}, {}
 
     def _transition_waiting_memory(
@@ -1062,6 +1098,16 @@ class SchedulerState:
                 if startstop.get("action") == "compute":
                     duration = startstop["stop"] - startstop["start"]
                     ts.prefix.add_duration(duration)
+                    # the prefix now HAS a measured duration: release
+                    # the tasks parked under it at placement time
+                    # (reference scheduler.py pops unknown_durations in
+                    # _transition_processing_memory).  This dict was
+                    # append-only — every TaskState placed before its
+                    # prefix's first completion was pinned FOREVER,
+                    # with its whole dependency-object cluster: ~10 GB
+                    # over a 1M-task simulated run (found by the
+                    # sim_10k headline; invisible at test scale).
+                    self.unknown_durations.pop(ts.prefix.name, None)
                     ts.group.duration += duration
                     if not ts.group.start:
                         ts.group.start = startstop["start"]
@@ -1332,9 +1378,16 @@ class SchedulerState:
             assert not ts.who_has
             assert not ts.processing_on
             assert not ts.waiting_on
-            assert not any(
-                dts.state != "forgotten" for dts in ts.dependents
-            ), (ts, [d for d in ts.dependents if d.state != "forgotten"])
+            # pure data (scatter) may be forgotten while dependents
+            # remain: it cannot be recomputed, so holding the record
+            # preserves nothing — the reference allows exactly this
+            # ("It's ok to forget a pure data task", scheduler.py
+            # _transition_released_forgotten).  Found by the simulator's
+            # scatter -> consume -> client-release flow under validate.
+            if ts.run_spec is not None:
+                assert not any(
+                    dts.state != "forgotten" for dts in ts.dependents
+                ), (ts, [d for d in ts.dependents if d.state != "forgotten"])
         recommendations: dict[Key, str] = {}
         self._propagate_forgotten(ts, recommendations)
         client_msgs = self._task_erred_or_forgotten_report(ts)
@@ -1981,7 +2034,7 @@ class SchedulerState:
         (reference scheduler.no-workers-timeout): their restrictions
         cannot be satisfied by the current fleet, and waiting forever
         hides the misconfiguration from the client."""
-        now = time()
+        now = self.clock()
         recs: dict[Key, str] = {}
         for ts, since in list(self.unrunnable.items()):
             if now - since <= timeout:
@@ -2064,7 +2117,7 @@ class SchedulerState:
         if isinstance(topic, str):
             topic = [topic]
         topic = list(topic)
-        stamp = time()
+        stamp = self.clock()
         for t in topic:
             self.events[t].append((stamp, msg))
             self.event_counts[t] += 1
@@ -2210,7 +2263,7 @@ class SchedulerState:
                     "transitions", {"recs": dict(recommendations)},
                     stimulus_id,
                 )
-            t0 = time()
+            t0 = self.clock()
             # fault isolation matches the per-message path (one logged
             # failure per message, the rest of the payload proceeds):
             # a poison round must not discard the messages of rounds
@@ -2226,7 +2279,7 @@ class SchedulerState:
                 )
             n = len(recommendations)
             self.hist_engine_batch.observe(n)
-            self.hist_engine_pass.observe(time() - t0)
+            self.hist_engine_pass.observe(self.clock() - t0)
             tr.emit("engine", "transitions", stimulus_id, n=n)
         return client_msgs, worker_msgs
 
@@ -2249,7 +2302,7 @@ class SchedulerState:
         if not isinstance(finishes, (list, tuple)):
             finishes = list(finishes)
         tr = self.trace
-        t0 = time()
+        t0 = self.clock()
         for key, worker, stimulus_id, kwargs in finishes:
             if tr.journal_enabled:
                 tr.record(
@@ -2303,7 +2356,7 @@ class SchedulerState:
                 )
         if finishes:
             self.hist_engine_batch.observe(len(finishes))
-            self.hist_engine_pass.observe(time() - t0)
+            self.hist_engine_pass.observe(self.clock() - t0)
             tr.emit(
                 "engine", "task-finished-batch", finishes[0][2],
                 n=len(finishes),
@@ -2322,7 +2375,7 @@ class SchedulerState:
         if not isinstance(errors, (list, tuple)):
             errors = list(errors)
         tr = self.trace
-        t0 = time()
+        t0 = self.clock()
         for key, worker, stimulus_id, kwargs in errors:
             if tr.journal_enabled:
                 tr.record(
@@ -2359,7 +2412,7 @@ class SchedulerState:
                 )
         if errors:
             self.hist_engine_batch.observe(len(errors))
-            self.hist_engine_pass.observe(time() - t0)
+            self.hist_engine_pass.observe(self.clock() - t0)
             tr.emit(
                 "engine", "task-erred-batch", errors[0][2], n=len(errors)
             )
@@ -2414,6 +2467,128 @@ class SchedulerState:
         # "waiting" routes erred -> released -> waiting (reference :5131)
         return self.transitions({k: "waiting" for k in roots}, stimulus_id)
 
+    # ------------------------------------- worker stream stimuli (pure)
+    #
+    # Pure bodies of the scheduler server's scalar worker-op handlers
+    # (add-keys / long-running / reschedule / missing-data /
+    # request-refresh-who-has).  The networked Scheduler wraps each in a
+    # thin trace-ingress + send_all shell; the sans-io cluster simulator
+    # (distributed_tpu/sim) calls them directly, so both planes run ONE
+    # implementation instead of drifting copies.
+
+    def stimulus_add_keys(
+        self, keys: Iterable[Key], worker: str, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """Worker acquired replicas out-of-band (reference scheduler.py:5855).
+
+        Journaled: replica registration mutates ``who_has`` OUTSIDE the
+        transition engine, and placement decisions read it — a journal
+        without add-keys replays a dependency graph with drifting
+        placements (found by the simulator's record/replay parity
+        test; the dep-free bench flood never exercised it)."""
+        keys = list(keys)
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "add-keys", {"keys": keys, "worker": worker}, stimulus_id
+            )
+        ws = self.workers.get(worker)
+        if ws is None:
+            return {}, {}
+        redundant = []
+        for key in keys:
+            ts = self.tasks.get(key)
+            if ts is not None and ts.state == "memory":
+                self.add_replica(ts, ws)
+            else:
+                redundant.append(key)
+        if redundant:
+            return {}, {worker: [{
+                "op": "remove-replicas", "keys": redundant,
+                "stimulus_id": stimulus_id,
+            }]}
+        return {}, {}
+
+    def stimulus_long_running(
+        self, key: Key, worker: str, compute_duration: float,
+        stimulus_id: str,
+    ) -> tuple[dict, dict]:
+        """Task seceded from its thread slot (reference scheduler.py:5906)."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "long-running",
+                {"key": key, "worker": worker,
+                 "compute_duration": compute_duration},
+                stimulus_id,
+            )
+        ts = self.tasks.get(key)
+        if ts is None or ts.processing_on is None:
+            return {}, {}
+        ws = ts.processing_on
+        if ws.address != worker:
+            return {}, {}
+        occ = ws.processing.get(ts)
+        if occ is not None:
+            self._adjust_occupancy(ws, -occ)
+            # graft-lint: allow[mirror-parity] row marked by the _adjust_occupancy above and the check_idle_saturated below
+            ws.processing[ts] = 0.0
+        ws.long_running.add(ts)
+        self.check_idle_saturated(ws)
+        return {}, {}
+
+    def stimulus_reschedule(
+        self, key: Key, worker: str, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """Worker bounced the task back for re-placement (Reschedule)."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "reschedule", {"key": key, "worker": worker}, stimulus_id
+            )
+        ts = self.tasks.get(key)
+        if ts is None or ts.processing_on is None:
+            return {}, {}
+        if ts.processing_on.address != worker:
+            return {}, {}
+        # _transitions_observed, NOT transitions: this stimulus already
+        # journaled itself, and replay re-derives the round from it — a
+        # nested "transitions" record would run the round twice
+        return self._transitions_observed({key: "released"}, stimulus_id)
+
+    def stimulus_missing_data(
+        self, key: Key, errant_worker: str, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """A peer did not have data it was supposed to (reference :5869)."""
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "missing-data",
+                {"key": key, "errant_worker": errant_worker}, stimulus_id,
+            )
+        ts = self.tasks.get(key)
+        ws = self.workers.get(errant_worker)
+        if ts is None:
+            return {}, {}
+        if ws is not None and ws in ts.who_has:
+            self.remove_replica(ts, ws)
+        if not ts.who_has:
+            # see stimulus_reschedule: self-journaled, so the round must
+            # not journal again
+            return self._transitions_observed({key: "released"}, stimulus_id)
+        return {}, {}
+
+    def stimulus_request_refresh_who_has(
+        self, keys: Iterable[Key], worker: str, stimulus_id: str
+    ) -> tuple[dict, dict]:
+        """A worker wants fresh replica locations for its missing tasks."""
+        who_has = {}
+        for key in keys:
+            ts = self.tasks.get(key)
+            who_has[key] = (
+                [ws.address for ws in ts.who_has] if ts is not None else []
+            )
+        return {}, {worker: [{
+            "op": "refresh-who-has", "who_has": who_has,
+            "stimulus_id": stimulus_id,
+        }]}
+
     # ------------------------------------------------ worker lifecycle
 
     def add_worker_state(
@@ -2433,6 +2608,11 @@ class SchedulerState:
             address, nthreads=nthreads, memory_limit=memory_limit, name=name,
             server_id=server_id,
         )
+        # keep the engine's clock domain: WorkerState's constructor
+        # stamps the module clock, but inside this engine every
+        # timestamp reads the injected clock (virtual in the simulator;
+        # the live server overwrites last_seen on each heartbeat)
+        ws.last_seen = self.clock()
         if resources:
             ws.resources.update(resources)
             ws.used_resources = dict.fromkeys(resources, 0)
@@ -2442,7 +2622,7 @@ class SchedulerState:
         self.aliases[ws.name] = address
         self.running.add(ws)
         self.total_nthreads += nthreads
-        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         if self.mirror is not None:
             self.mirror.on_add_worker(ws)
         self.check_idle_saturated(ws)
@@ -2469,7 +2649,7 @@ class SchedulerState:
         so the mirror's resize delta path stays proven."""
         self.total_nthreads += nthreads - ws.nthreads
         ws.nthreads = nthreads
-        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         self.check_idle_saturated(ws)
 
     def bulk_schedule_unrunnable_after_adding_worker(self, ws: WorkerState) -> dict[Key, str]:
@@ -2501,6 +2681,13 @@ class SchedulerState:
         ws = self.workers.get(address)
         if ws is None:
             return {}, {}
+        if self.trace.journal_enabled:
+            # worker removal rewrites replica truth and reschedules its
+            # processing set — a chaos capture replays it as its own op
+            self.trace.record(
+                "remove-worker", {"worker": address, "safe": bool(safe)},
+                stimulus_id,
+            )
         del self.workers[address]
         self.aliases.pop(ws.name, None)
         self.telemetry.forget_worker(address)
@@ -2510,7 +2697,7 @@ class SchedulerState:
         self.idle_task_count.discard(ws)
         self.saturated.discard(ws)
         self.total_nthreads -= ws.nthreads
-        self.total_nthreads_history.append((time(), self.total_nthreads))
+        self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         self._total_occupancy -= ws.occupancy
         ws.occupancy = 0.0
         for r in ws.resources:
@@ -2574,7 +2761,7 @@ class SchedulerState:
     def add_client_state(self, client: str) -> ClientState:
         cs = self.clients.get(client)
         if cs is None:
-            cs = self.clients[client] = ClientState(client)
+            cs = self.clients[client] = ClientState(client, self.clock())
         return cs
 
     def client_desires_keys(self, keys: Iterable[Key], client: str) -> None:
@@ -2660,7 +2847,7 @@ class SchedulerState:
         if self.computations and not self.computations[-1].groups:
             computation = self.computations[-1]
         else:
-            computation = Computation()
+            computation = Computation(self.clock())
             self.computations.append(computation)
         touched: list[TaskState] = []
         for key, spec in tasks.items():

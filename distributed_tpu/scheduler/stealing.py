@@ -84,6 +84,14 @@ class WorkStealing:
         # transition and its (debounced) tick
         self._kick_pending = False
         self._last_balance = 0.0
+        # injectable seams (ROADMAP item 1 simulator): the sans-io sim
+        # re-points ``clock`` at its VirtualClock (the 0.05 s python
+        # cycle bound must never read the wall clock there — a wall
+        # break mid-cycle would make two same-seed runs diverge) and
+        # ``seq`` at a per-run deterministic id mint (seq_name is a
+        # process-global counter, so ids would differ between runs)
+        self.clock = time
+        self.seq = seq_name
         self._rr = 0  # round-robin cursor for dep-free thief choice
         # off-loop device-plan pipeline (see _balance_device)
         self._device_plan_inflight = False
@@ -200,7 +208,7 @@ class WorkStealing:
         key = ts.key
         if key in self.in_flight:
             return
-        stimulus_id = seq_name("steal")
+        stimulus_id = self.seq("steal")
         victim_duration = victim.processing.get(ts, 0.0)
         comm_cost = self.state.get_comm_cost(ts, thief)
         # shadow divergence monitor (read-only): this steal was priced
@@ -246,7 +254,7 @@ class WorkStealing:
         ):
             # dead thief: leave the task in stealable for the next cycle
             return
-        stimulus_id = seq_name("steal-spec")
+        stimulus_id = self.seq("steal-spec")
         # same shadow hop as the confirm path: the criterion priced this
         # move with the constant model just before calling here
         # (constant=None: recomputed only behind the sampling gate)
@@ -347,13 +355,13 @@ class WorkStealing:
         if (
             self.enabled
             and not self.scheduler._ongoing_background_tasks.closed
-            and time() - self._last_balance >= 0.02
+            and self.clock() - self._last_balance >= 0.02
         ):
             self.balance()
 
     def balance(self) -> None:
         """One stealing cycle (reference stealing.py:402)."""
-        self._last_balance = time()
+        self._last_balance = self.clock()
         s = self.state
         if not s.idle or len(s.workers) < 2:
             return
@@ -398,7 +406,7 @@ class WorkStealing:
                 key=lambda ws: ws.occupancy / max(ws.nthreads, 1),
                 reverse=True,
             )[:10]
-        start = time()
+        start = self.clock()
         for victim in victims:
             levels = self.stealable.get(victim.address)
             if levels is None:
@@ -442,7 +450,7 @@ class WorkStealing:
                             idle_workers = [
                                 w for w in idle_workers if w is not thief
                             ]
-            if time() - start > 0.05:  # bound cycle time like the reference
+            if self.clock() - start > 0.05:  # bound cycle time like the reference
                 break
 
     # bounds for one device cycle, mirroring the python path's top-10

@@ -1,0 +1,84 @@
+"""Policy A/B driver: the same trace under two config dicts, diffed in
+virtual time.
+
+Because the whole cluster runs on the virtual clock, an A/B here
+compares POLICIES, not host phases: the box's 2x wall-clock drift
+(PERF.md) cannot touch either arm's makespan, and two arms with
+identical policies produce identical digests.  Knobs that matter are
+the ones the live scheduler reads from config — steal cadence
+(``scheduler.work-stealing-interval``), speculative stealing, AMM
+interval, saturation — plus the sim-level ones (fleet shape, link
+profile, straggler factors) passed through ``sim_kwargs``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from distributed_tpu.sim.core import ClusterSim
+
+
+def run_policy(
+    n_workers: int,
+    trace_factory: Callable[[], Any],
+    *,
+    seed: int = 0,
+    config_overrides: dict[str, Any] | None = None,
+    **sim_kwargs: Any,
+) -> dict:
+    """One arm: build a fresh sim, run the trace, return the report
+    (with whole-run digest)."""
+    sim = ClusterSim(
+        n_workers, seed=seed, config_overrides=config_overrides,
+        **sim_kwargs,
+    )
+    sim.install_digest()
+    trace_factory().start(sim)
+    report = sim.run()
+    report["digest"] = sim.digest()
+    report["config_overrides"] = dict(config_overrides or {})
+    return report
+
+
+def run_ab(
+    n_workers: int,
+    trace_factory: Callable[[], Any],
+    overrides_a: dict[str, Any] | None,
+    overrides_b: dict[str, Any] | None,
+    *,
+    seed: int = 0,
+    **sim_kwargs: Any,
+) -> dict:
+    """Both arms over the same seed + trace; returns
+    ``{"a": ..., "b": ..., "diff": ...}`` with the virtual-time deltas
+    a policy decision should be judged on."""
+    a = run_policy(
+        n_workers, trace_factory, seed=seed,
+        config_overrides=overrides_a, **sim_kwargs,
+    )
+    b = run_policy(
+        n_workers, trace_factory, seed=seed,
+        config_overrides=overrides_b, **sim_kwargs,
+    )
+
+    def _delta(field: str) -> float | None:
+        va, vb = a.get(field), b.get(field)
+        if va is None or vb is None:
+            return None
+        return vb - va
+
+    return {
+        "a": a,
+        "b": b,
+        "diff": {
+            "virtual_makespan_s": _delta("virtual_makespan_s"),
+            "makespan_ratio": (
+                b["virtual_makespan_s"] / a["virtual_makespan_s"]
+                if a.get("virtual_makespan_s") and b.get("virtual_makespan_s")
+                else None
+            ),
+            "steals": _delta("steals"),
+            "scheduler_transitions": _delta("scheduler_transitions"),
+            "events": _delta("events"),
+        },
+    }

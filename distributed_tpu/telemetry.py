@@ -164,6 +164,24 @@ class LinkStats:
         return out
 
 
+def parse_link_profile(records: list[dict]) -> dict[tuple[str, str], tuple[float, float]]:
+    """Parse link-profile (or full ``/telemetry``) records into
+    ``{(src, dst): (bandwidth_bytes_per_s, latency_s)}`` — the seedable
+    form the simulator's ``LinkProfile`` consumes.  Rows that are not
+    ``type == "link"`` or carry no measured bandwidth are skipped."""
+    out: dict[tuple[str, str], tuple[float, float]] = {}
+    for rec in records:
+        if rec.get("type") != "link":
+            continue
+        bw = float(rec.get("bandwidth") or 0.0)
+        if bw <= 0.0:
+            continue
+        out[(str(rec.get("src", "")), str(rec.get("dst", "")))] = (
+            bw, max(float(rec.get("latency") or 0.0), 0.0)
+        )
+    return out
+
+
 class PrefixPrior:
     """Measured per-task-prefix priors: EWMA duration and output bytes
     (the measured twin of ``TaskPrefix.duration_average`` /
@@ -205,6 +223,12 @@ class LinkTelemetry:
             enabled = bool(config.get("scheduler.telemetry.enabled"))
         self.alpha = alpha
         self.enabled = bool(enabled)
+        # injectable clock (ROADMAP item 1 simulator): snapshots are the
+        # only place this collector stamps time — the fold path takes
+        # ``seconds`` as data, never reads a clock — so re-pointing this
+        # at a VirtualClock keeps simulated-transfer EWMAs and their
+        # /telemetry records entirely on virtual time.
+        self.clock = time
         self.links: dict[tuple[str, str], LinkStats] = {}
         # since-heartbeat delta: (src, dst) -> [nbytes, seconds, count]
         self.since_heartbeat: dict[tuple[str, str], list] = {}
@@ -298,12 +322,37 @@ class LinkTelemetry:
         monotonic ``ts`` per snapshot so records line up with
         flight-recorder events on the same in-process clock."""
         if now is None:
-            now = time()
+            now = self.clock()
         out = []
         for link in self.links.values():
             rec = link.record()
             rec["ts"] = now
             out.append(rec)
+        return out
+
+    # ------------------------------------------------------ link profiles
+
+    def link_profile(self) -> list[dict]:
+        """Export the measured per-link state as a *link profile*: the
+        minimal ``{src, dst, bandwidth, latency, count}`` rows the
+        ROADMAP item 1 simulator seeds its network model from
+        (``distributed_tpu.sim.links.LinkProfile.from_records``).  Full
+        ``/telemetry`` link records parse too — this export just strips
+        the cross-check totals and digest quantiles a simulation cannot
+        use."""
+        out = []
+        for link in self.links.values():
+            if not link.bandwidth.count:
+                continue
+            out.append({
+                "v": TELEMETRY_SCHEMA_VERSION,
+                "type": "link",
+                "src": link.src,
+                "dst": link.dst,
+                "bandwidth": link.bandwidth.value,
+                "latency": link.latency.value,
+                "count": link.bandwidth.count,
+            })
         return out
 
 
@@ -417,7 +466,7 @@ class ClusterTelemetry(LinkTelemetry):
 
     def snapshot(self, now: float | None = None) -> list[dict]:
         if now is None:
-            now = time()
+            now = self.clock()
         out = super().snapshot(now)
         for worker, rtt in self.rtt.items():
             out.append({

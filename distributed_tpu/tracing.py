@@ -91,6 +91,12 @@ class FlightRecorder:
         while size < ring_size:
             size <<= 1  # pow2 so the hot path masks instead of modding
         self._mask = size - 1
+        # injectable clock (ROADMAP item 1 simulator): the sim harness
+        # re-points this at its VirtualClock so ring events and journal
+        # records are stamped in VIRTUAL seconds — two same-seed sim
+        # runs then produce byte-identical journals.  Live recorders
+        # keep the monotonic utils.misc.time.
+        self.clock = time
         # preallocated slots, mutated in place: the fast path allocates
         # nothing (gate: bench.py --smoke "trace" alloc check)
         self._slots: list[list] = [
@@ -113,7 +119,7 @@ class FlightRecorder:
             return
         i = self._i
         slot = self._slots[i & self._mask]
-        slot[0] = time()
+        slot[0] = self.clock()
         slot[1] = cat
         slot[2] = name
         slot[3] = stim
@@ -157,7 +163,7 @@ class FlightRecorder:
             "seq": seq,
             "op": op,
             "stim": stim,
-            "ts": time(),
+            "ts": self.clock(),
             "digest": payload_digest(payload),
             "payload": payload,
         })
@@ -238,6 +244,27 @@ def from_jsonl(text: str | bytes) -> list[dict]:
     if isinstance(text, bytes):
         text = text.decode()
     return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def dump_journal(records: Iterable[dict], path: str) -> int:
+    """Write a stimulus journal (or any event list) to ``path`` as JSONL.
+    Returns the number of records written.  The on-disk format is the
+    same schema-versioned record stream ``/trace`` serves, so a dumped
+    journal replays through ``replay_stimulus_trace`` and through the
+    simulator's journal trace source unchanged."""
+    records = list(records)
+    with open(path, "w") as f:
+        f.write(to_jsonl(records))
+    return len(records)
+
+
+def load_journal(path: str) -> list[dict]:
+    """Load a JSONL stimulus journal from disk (the counterpart of
+    :func:`dump_journal`; the simulator's recorded-trace source).
+    Integrity is NOT checked here — ``verify_journal`` (diagnostics.
+    flight_recorder) runs digest + contiguity checks before any replay."""
+    with open(path) as f:
+        return from_jsonl(f.read())
 
 
 def payload_digest(payload: Any) -> str:

@@ -419,6 +419,7 @@ class WorkerState:
         transfer_message_bytes_limit: int | None = None,
         execute_pipeline: int = 0,
         execute_pipeline_threshold: float = 0.005,
+        clock: Callable[[], float] | None = None,
     ):
         self.address = address
         self.nthreads = nthreads
@@ -471,14 +472,25 @@ class WorkerState:
         self.stimulus_log: deque = deque(maxlen=10_000)
         # flight recorder (tracing.py): stimulus batches land here with
         # the same scheduler-minted stimulus ids the scheduler's ring
-        # carries, so /trace on both roles joins on one causal id
+        # carries, so /trace on both roles joins on one causal id.
+        # This machine never reads a clock itself — the injectable
+        # ``clock`` (ROADMAP item 1 simulator) only re-stamps its trace
+        # ring onto virtual time.
         self.trace = FlightRecorder()
+        if clock is not None:
+            self.trace.clock = clock
         self.rng = random.Random(0)  # deterministic (reference wsm.py:1328)
         self.task_counter: defaultdict[str, int] = defaultdict(int)
 
         self._transitions_table: dict[tuple[str, str], Callable] = {
             ("released", "waiting"): self._transition_released_waiting,
             ("released", "fetch"): self._transition_released_fetch,
+            # released_fetch recommends "missing" when the dep has NO
+            # known holders (a compute-task/acquire-replicas can name a
+            # dep whose replicas just vanished): without this edge that
+            # recommendation raised InvalidTransition and killed the
+            # stimulus batch (found by the simulator's worker suite)
+            ("released", "missing"): self._transition_fetch_missing,
             ("released", "memory"): self._transition_released_memory,
             ("released", "forgotten"): self._transition_released_forgotten,
             ("waiting", "ready"): self._transition_waiting_ready,
@@ -497,6 +509,17 @@ class WorkerState:
             ("long-running", "error"): self._transition_executing_error,
             ("long-running", "released"): self._transition_executing_released,
             ("long-running", "rescheduled"): self._transition_executing_rescheduled,
+            # a fetch/missing/error task re-targeted as a COMPUTE: the
+            # compute-task handler wires waiting_for_data BEFORE the
+            # transition, and the released fallback would wipe it —
+            # released->waiting then sees no pending deps and sends the
+            # task to ready with its inputs absent (tripped the ready
+            # invariant; found by the simulator's partition chaos
+            # scenario, where the recompute of a task whose replica the
+            # partition stripped lands on a worker that had it "missing")
+            ("missing", "waiting"): self._transition_redirected_waiting,
+            ("fetch", "waiting"): self._transition_redirected_waiting,
+            ("error", "waiting"): self._transition_redirected_waiting,
             ("fetch", "flight"): self._transition_fetch_flight,
             ("fetch", "released"): self._transition_generic_released,
             ("fetch", "missing"): self._transition_fetch_missing,
@@ -1023,6 +1046,19 @@ class WorkerState:
         self.tasks.pop(ts.key, None)
         ts.state = "forgotten"
         return {}, []
+
+    def _transition_redirected_waiting(self, ts, *, stimulus_id):
+        """A data-target (fetch/missing) or failed task re-assigned as a
+        compute: leave the dependency wiring the compute-task handler
+        just built intact and enter waiting directly — the released
+        fallback would clear ``waiting_for_data`` and race the task to
+        ready without its inputs."""
+        self._purge_data_needed(ts)
+        ts.exception = None
+        ts.traceback = None
+        ts.exception_text = ""
+        ts.traceback_text = ""
+        return self._transition_released_waiting(ts, stimulus_id=stimulus_id)
 
     def _transition_waiting_ready(self, ts, *, stimulus_id):
         if self.validate:

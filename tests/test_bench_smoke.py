@@ -101,3 +101,20 @@ def test_bench_smoke_runs_and_reports():
     assert telemetry["overhead_pct"] < 5.0
     assert telemetry["shadow_evals"] > 0
     assert telemetry["host_canary_ms"] > 0
+    # sans-io cluster simulator (distributed_tpu/sim, docs/simulator.md):
+    # two same-seed runs of the sim_10k miniature — real engines, steal
+    # + AMM cycles live, virtual clock — produced BIT-IDENTICAL digests
+    # and virtual makespans, a chaos worker-death run converged with
+    # zero lost keys, and a sim-recorded stimulus journal replayed
+    # through the batched engine to the identical transition stream
+    # (the bench half raises on any violation; these asserts pin the
+    # contract in the gate's own output)
+    sim = out["configs"]["sim"]
+    assert sim["deterministic"] is True
+    assert sim["virtual_makespan_s"] > 0
+    assert sim["n_tasks"] > 0
+    assert sim["decisions_per_s"] > 0
+    assert sim["steals"] > 0
+    assert sim["chaos_death_lost"] == 0
+    assert sim["replay_match"] is True
+    assert sim["replay_rows"] > 0

@@ -270,14 +270,34 @@ def test_jax_placement_mesh_plan_path_and_stats():
         assert ss["full_packs"] == [1, 1]
 
 
-def test_jax_placement_mesh_disabled_leaves_single_device_path():
-    """Default config: the mesh path stays off and plan snapshots carry
-    no mesh — the single-device engine is untouched."""
+def test_jax_placement_mesh_auto_default():
+    """``scheduler.jax.mesh.enabled`` defaults to "auto" (ROADMAP item
+    2 leftover): on when more than one device is visible at mesh-build
+    time, single-device path otherwise, explicit booleans force."""
     from distributed_tpu.scheduler.jax_placement import JaxPlacement
 
+    # default parses to auto (None)
     placement = JaxPlacement(min_batch=4, min_workers=0, sync=True)
-    assert placement.mesh_enabled is False
-    assert placement._get_mesh(build=True) is None
+    assert placement.mesh_enabled is None
+
+    # explicit off stays off, never builds
+    with config.set({"scheduler.jax.mesh.enabled": False}):
+        off = JaxPlacement(min_batch=4, min_workers=0, sync=True)
+        assert off.mesh_enabled is False
+        assert off._get_mesh(build=True) is None
+
+    # auto on a 1-device host: the single-device path (a 1x1 mesh is
+    # bit-identical but pays dispatch overhead for nothing)
+    single = JaxPlacement(min_batch=4, min_workers=0, sync=True)
+    single._n_visible = lambda: 1  # instance shadow of the probe
+    assert single._get_mesh(build=True) is None
+
+    # auto on this multi-device host: the mesh builds
+    if len(jax.devices()) >= 2:
+        multi = JaxPlacement(min_batch=4, min_workers=0, sync=True)
+        mesh = multi._get_mesh(build=True)
+        assert mesh is not None
+        assert mesh.devices.size == len(jax.devices())
 
 
 def test_jax_placement_bad_layout_falls_back():

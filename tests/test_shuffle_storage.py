@@ -207,22 +207,36 @@ async def test_p2p_merge_outer_join_includes_misses():
 # ------------------------------------------------- restart / fault tolerance
 
 
-@gen_test(timeout=120)
+@gen_test(timeout=180)
 async def test_mid_shuffle_worker_loss_restarts_with_bumped_run_id():
     """Killing a participating worker mid-shuffle bumps the run_id and
-    the shuffle completes on the survivors."""
+    the shuffle completes on the survivors.
+
+    THIN integration smoke: the full worker-death recovery semantics
+    (lineage recompute, replica truth, no lost keys, model-legal
+    transitions) are covered deterministically by the simulator's
+    chaos suite (tests/test_sim.py::test_chaos_worker_death) — this
+    live test only proves the networked shuffle extension's restart
+    protocol end to end, with small data and generous timeouts (the
+    old 6x200-int / 90 s-in-120 s variant flaked under full-suite
+    load, PR 6 tier-1 run)."""
     async with await new_cluster(n_workers=3) as cluster:
         async with Client(cluster.scheduler_address) as c:
             ext = cluster.scheduler.extensions["shuffle"]
             inputs = [
-                c.submit(big_partition, i, key=f"in-{i}") for i in range(6)
+                c.submit(big_partition, i, key=f"in-{i}") for i in range(4)
             ]
             await c.gather(inputs)
 
-            outs = await p2p_shuffle(c, inputs, npartitions_out=6)
+            outs = await p2p_shuffle(c, inputs, npartitions_out=4)
             # wait until the shuffle is registered and has begun
-            while not ext.active:
+            # (bounded: a wedge here must fail loudly, not eat the
+            # whole gen_test budget spinning)
+            for _ in range(2000):
+                if ext.active:
+                    break
                 await asyncio.sleep(0.01)
+            assert ext.active, "shuffle never registered"
             sid = next(iter(ext.active))
             victim_addr = ext.active[sid].worker_for[0]
             victim = next(
@@ -231,10 +245,10 @@ async def test_mid_shuffle_worker_loss_restarts_with_bumped_run_id():
             await victim.close()
             cluster.workers.remove(victim)
 
-            results = await asyncio.wait_for(c.gather(outs), 90)
+            results = await asyncio.wait_for(c.gather(outs), 150)
             assert ext.active[sid].run_id >= 2
             assert victim_addr not in set(ext.active[sid].worker_for.values())
-            all_in = sorted(x for i in range(6) for x in big_partition(i))
+            all_in = sorted(x for i in range(4) for x in big_partition(i))
             all_out = sorted(x for part in results for x in part)
             assert all_out == all_in
 
