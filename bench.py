@@ -1481,6 +1481,193 @@ def _smoke_trace() -> dict:
     }
 
 
+async def _smoke_stall_watchdog() -> dict:
+    """Deterministic stall-watchdog half of the selfprofile gate: a
+    synthetic loop block (a tight busy-wait INSIDE a coroutine, well
+    past the threshold) must produce EXACTLY ONE stall capture whose
+    traceback names the blocking frame, plus a flight-recorder
+    ``stall`` event — and a recovered loop must re-arm cleanly."""
+    import asyncio
+    import threading
+
+    from distributed_tpu.diagnostics.selfprofile import LoopWatchdog
+    from distributed_tpu.tracing import FlightRecorder
+
+    tr = FlightRecorder(enabled=True, ring_size=64)
+    wd = LoopWatchdog(trace=tr, interval=0.02, stall_threshold=0.12)
+    wd.start(threading.get_ident())
+
+    async def ticker():
+        while True:
+            wd.tick()
+            await asyncio.sleep(0.02)
+
+    tick_task = asyncio.create_task(ticker())
+    try:
+        await asyncio.sleep(0.1)  # healthy baseline ticks
+
+        def _block_loop():
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 0.35:
+                pass  # the synthetic stall: the loop thread is pinned here
+
+        _block_loop()
+        await asyncio.sleep(0.3)  # recovery window: watchdog re-arms
+    finally:
+        tick_task.cancel()
+        wd.stop()
+    assert wd.stalls_total == 1, (
+        f"expected exactly one stall capture, got {wd.stalls_total}"
+    )
+    stall = wd.stalls[0]
+    assert "_block_loop" in stall["traceback"], stall["traceback"]
+    stall_events = [e for e in tr.tail() if e["cat"] == "stall"]
+    assert len(stall_events) == 1 and "_block_loop" in stall_events[0]["key"]
+    assert wd.hist_lag.count > 0
+    return {
+        "stall_events": wd.stalls_total,
+        "stall_lag_s": stall["lag_s"],
+        "stall_frame_named": True,
+        "ticks": wd.ticks_total,
+    }
+
+
+def _smoke_selfprofile() -> dict:
+    """Control-plane self-profiler gate (diagnostics/selfprofile.py;
+    docs/observability.md "Self-profiling"): floods the batched engine
+    with the always-on control-plane sampler ON vs OFF on identical
+    synthetic states (same-session A/B, min-per-pair-ratio estimator —
+    the drift-robust gate from the trace smoke) and raises if
+
+    - sampling-on overhead exceeds 5% (the always-on contract),
+    - the sampled tree carries no phase-stamped samples or the wall
+      budget recorded no ``engine.drain`` seconds,
+    - arm attribution (opt-in) produces no per-arm rows, or
+    - the deterministic stall-watchdog scenario above fails.
+    """
+    import asyncio
+    import threading
+
+    from distributed_tpu.diagnostics.selfprofile import ControlPlaneProfiler
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.graph.spec import TaskSpec
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    # REPS 7: the min-per-pair estimator needs one CLEAN pair (see the
+    # trace smoke's rationale)
+    N_WORKERS, N_TASKS, REPS = 16, 2000, 7
+
+    def build():
+        state = SchedulerState(validate=False)
+        for i in range(N_WORKERS):
+            state.add_worker_state(
+                f"tcp://prof:{i}", nthreads=2, memory_limit=2**30,
+                name=f"p{i}",
+            )
+        tasks = {f"prf-{i}": TaskSpec(_inc, (i,)) for i in range(N_TASKS)}
+        state.update_graph_core(
+            tasks, {k: set() for k in tasks}, list(tasks),
+            client="smoke", stimulus_id="smoke-selfprofile-graph",
+        )
+        return state
+
+    def flood(state) -> float:
+        t0 = time.perf_counter()
+        rounds = 0
+        while True:
+            batch = [
+                (ts.key, ws.address, f"prf-fin-{ts.key}", {"nbytes": 8})
+                for ws in state.workers.values()
+                for ts in list(ws.processing)
+            ]
+            if not batch:
+                break
+            state.stimulus_tasks_finished_batch(batch)
+            rounds += 1
+            assert rounds < 10 * N_TASKS, "flood did not converge"
+        return time.perf_counter() - t0
+
+    main_ident = threading.get_ident()
+
+    def run(profiled: bool) -> float:
+        state = build()
+        prof = None
+        if profiled:
+            # default config rate: the gate measures the ALWAYS-ON cost
+            prof = ControlPlaneProfiler(
+                idents=lambda: [main_ident], wall=state.wall
+            )
+            prof.start()
+        try:
+            return flood(state)
+        finally:
+            if prof is not None:
+                prof.stop()
+
+    run(True)   # untimed warmup per arm (allocator/code warm)
+    run(False)
+    on_walls, off_walls = [], []
+    for _ in range(REPS):
+        on_walls.append(run(True))
+        off_walls.append(run(False))
+    min_ratio = min(on / off for on, off in zip(on_walls, off_walls))
+    overhead_pct = max(0.0, (min_ratio - 1.0) * 100)
+    assert overhead_pct < 5.0, (
+        f"sampling-on overhead {overhead_pct:.1f}% exceeds the 5% budget "
+        f"(on={on_walls}, off={off_walls})"
+    )
+
+    # attribution probe: a dense-rate profiled flood must produce
+    # phase-stamped samples and nonzero engine.drain wall
+    probe = build()
+    prof = ControlPlaneProfiler(
+        idents=lambda: [main_ident], wall=probe.wall, interval=0.002
+    )
+    prof.start()
+    flood(probe)
+    prof.stop()
+    wall = probe.wall.snapshot()
+    assert wall.get("engine.drain", 0.0) > 0.0, wall
+    assert prof.total_samples > 0
+    tree = prof.get_profile()
+    phase_nodes = [
+        k for k in tree["children"] if k.startswith("phase:engine.drain")
+    ]
+    assert phase_nodes, list(tree["children"])
+    assert any(ph == "engine.drain" for _, ph, _s in prof.samples)
+
+    # opt-in arm attribution: per-arm rows exist and cover most of the
+    # engine wall (the sim.profile_run artifact's property); its cost
+    # is REPORTED here, gated only by the profile_run tier-1 test
+    with dtpu_config.set({"scheduler.profile.arm-attribution": True}):
+        arm_state = build()
+    arm_wall = flood(arm_state)
+    totals = arm_state.wall.snapshot()
+    arms = {
+        k: v for k, v in totals.items()
+        if k.startswith("engine.scalar-arm:")
+    }
+    assert arms, "arm attribution produced no per-arm rows"
+    engine_wall = totals.get("engine.drain", 0.0) + sum(arms.values())
+    arm_share = sum(arms.values()) / engine_wall if engine_wall else 0.0
+
+    out = asyncio.run(_smoke_stall_watchdog())
+    out.update({
+        "n_workers": N_WORKERS,
+        "n_tasks": N_TASKS,
+        "sampling_on_s": [round(w, 3) for w in on_walls],
+        "sampling_off_s": [round(w, 3) for w in off_walls],
+        "overhead_pct": round(overhead_pct, 2),
+        "samples": prof.total_samples,
+        "engine_drain_wall_s": round(wall["engine.drain"], 4),
+        "arm_rows": len(arms),
+        "arm_share": round(arm_share, 3),
+        "arm_flood_s": round(arm_wall, 3),
+        "host_canary_ms": _host_canary_ms(),
+    })
+    return out
+
+
 async def _smoke_telemetry_links() -> dict:
     """Measured-link half of the telemetry gate (telemetry.py): a tcp
     echo through the real comm stack files per-round-trip link samples
@@ -1782,6 +1969,7 @@ def run_smoke():
         "wire": asyncio.run(_smoke_wire()),
         "trace": retry_once(_smoke_trace),
         "telemetry": retry_once(_smoke_telemetry),
+        "selfprofile": retry_once(_smoke_selfprofile),
         "sim": _smoke_sim(),
         # LAST on purpose: the sharded programs spin up the 8-device
         # XLA runtime (one thread pool per virtual device on a 2-core

@@ -134,6 +134,30 @@ defaults: dict[str, Any] = {
             "journal": False,
             "journal-size": 65536,    # stimulus records kept in record mode
         },
+        # control-plane self-profiling (diagnostics/selfprofile.py;
+        # docs/observability.md "Self-profiling").  Shared by both
+        # roles, like the trace subtree: the worker's event loop reads
+        # the same knobs.
+        "profile": {
+            "enabled": True,
+            "interval": "20ms",       # control-plane sampling rate
+            "cycle": "1s",            # profile-tree rollover
+            "history": 60,            # cycles kept per profiler
+            # frame boundary: sampled stacks are cut at the asyncio
+            # dispatch machinery so the shared run_forever prefix (and
+            # an idle loop's selector frames) don't swamp the tree
+            "stop": "asyncio/base_events.py",
+            # loop lag beyond this triggers a stall capture (traceback
+            # of the blocked loop thread into the flight recorder)
+            "stall-threshold": "1s",
+            "watchdog-interval": "100ms",
+            # exact per-transition-arm wall accumulators
+            # (engine.scalar-arm:<start>,<finish>): the sim.profile_run
+            # payoff artifact turns this on; off by default because two
+            # monotonic reads per transition are NOT free on the flood
+            # path (the <5% smoke gate covers the default config)
+            "arm-attribution": False,
+        },
         # measured-truth telemetry plane (telemetry.py;
         # docs/observability.md): per-link transfer EWMAs/t-digests,
         # task-prefix priors, and the shadow cost-model divergence

@@ -44,6 +44,9 @@ class DumpArtefact:
         # measured-truth telemetry snapshot (telemetry.py): per-link
         # EWMAs/quantiles, priors, RTTs, divergence summary
         self.telemetry: list = list(sched.get("telemetry") or [])
+        # control-plane self-profile tail (diagnostics/selfprofile.py):
+        # wall budget, sampled loop/planner tree, stall captures
+        self.profile: dict = dict(sched.get("profile") or {})
 
     @classmethod
     def from_file(cls, path: str) -> "DumpArtefact":
@@ -119,6 +122,14 @@ class DumpArtefact:
             if (cat is None or ev.get("cat") == cat)
             and (stim is None or ev.get("stim") == stim)
         ]
+
+    def stalls(self) -> list[dict]:
+        """Stall captures from the dump's self-profile tail — the
+        post-mortem twin of the live ``/profile`` head record's
+        ``stalls`` list (each carries ``lag_s``, the in-progress
+        ``phase``/``stim`` and the blocked loop thread's formatted
+        ``traceback``)."""
+        return list(self.profile.get("stalls") or [])
 
     def telemetry_records(self, type_: str | None = None) -> list[dict]:
         """Telemetry snapshot records from the dump, optionally filtered

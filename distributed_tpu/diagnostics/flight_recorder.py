@@ -23,7 +23,13 @@ journal, schema).  This module is the offline half:
 
   reads JSONL events (live ``/trace`` endpoint, file, or stdin) and
   writes a Perfetto trace; without ``--perfetto`` it prints a per-
-  category/stimulus summary.
+  category/stimulus summary.  ``--speedscope OUT`` instead interprets
+  the input as ``/profile`` JSONL (diagnostics/selfprofile.py) and
+  writes a https://www.speedscope.app flamegraph of the control-plane
+  tree::
+
+      python -m distributed_tpu.diagnostics.flight_recorder \\
+          --url http://127.0.0.1:8787/profile --speedscope prof.json
 
 Schema contract: see docs/observability.md.  Every record carries
 ``v`` = ``tracing.TRACE_SCHEMA_VERSION``; the exporter refuses newer
@@ -54,6 +60,7 @@ _TRACKS = {
     "egress": (5, "egress (coalesced envelopes)"),
     "wstim": (6, "worker stimuli"),
     "shadow": (7, "shadow cost model (divergence samples)"),
+    "stall": (8, "loop stalls (watchdog captures)"),
 }
 _OTHER_TRACK = (9, "other")
 
@@ -413,6 +420,16 @@ def main(argv: list[str] | None = None) -> int:
         "--jsonl", metavar="OUT",
         help="re-emit the (possibly url-fetched) events as JSONL to OUT",
     )
+    parser.add_argument(
+        "--speedscope", metavar="OUT",
+        help="treat the input as /profile JSONL (control-plane "
+             "self-profile) and write a speedscope flamegraph to OUT",
+    )
+    parser.add_argument(
+        "--which", default="loop",
+        help="with --speedscope: which profile record to export "
+             "(loop | exec; default loop)",
+    )
     args = parser.parse_args(argv)
 
     if args.url:
@@ -432,6 +449,31 @@ def main(argv: list[str] | None = None) -> int:
                 telemetry = from_jsonl(f.read())
 
     wrote = False
+    if args.speedscope:
+        from distributed_tpu.diagnostics.selfprofile import (
+            profile_to_speedscope,
+        )
+
+        trees = [
+            r["tree"] for r in events
+            if r.get("kind") == "profile"
+            and r.get("which", "loop") == args.which
+        ]
+        if not trees:
+            print(
+                f"no {args.which!r} profile record in the input "
+                "(expected /profile JSONL)", file=sys.stderr,
+            )
+            return 1
+        with open(args.speedscope, "w") as f:
+            json.dump(
+                profile_to_speedscope(
+                    trees[0], name=f"dtpu-{args.which}-profile"
+                ),
+                f,
+            )
+        print(f"wrote speedscope profile to {args.speedscope}")
+        wrote = True
     if args.perfetto:
         with open(args.perfetto, "w") as f:
             json.dump(to_perfetto(events, telemetry=telemetry), f)

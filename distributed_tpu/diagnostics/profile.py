@@ -149,7 +149,7 @@ class _SharedWatcher:
                 if frame is None:
                     continue
                 for p in targets:
-                    p._add_sample(frame, now)
+                    p._add_sample(frame, now, ident)
 
 
 _shared_watcher = _SharedWatcher()
@@ -161,7 +161,8 @@ class Profiler:
 
     def __init__(self, thread_filter: str = "dtpu-worker-exec",
                  interval: float | None = None, cycle: float | None = None,
-                 maxlen: int = 60, idents=None, active=None):
+                 maxlen: int = 60, idents=None, active=None,
+                 stop: str | None = None):
         prof_cfg = config.get("worker.profile")
         self.interval = interval if interval is not None else config.parse_timedelta(
             prof_cfg["interval"]
@@ -179,6 +180,12 @@ class Profiler:
         # active: callable gating sampling; an idle worker skips the
         # sys._current_frames() call entirely
         self.active = active
+        # stop: frame boundary — stacks are cut at the first frame whose
+        # filename ends with this, so a shared outer prefix (the asyncio
+        # run_forever machinery under every control-plane sample) never
+        # swamps the tree (reference profile.py:123 ``stop``).  Stored
+        # as ``stop_file`` — ``stop()`` is the lifecycle method.
+        self.stop_file = stop
         self.current = create()
         self.history: deque = deque(maxlen=maxlen)  # (timestamp, tree)
         self._lock = threading.Lock()
@@ -190,6 +197,14 @@ class Profiler:
 
     def stop(self) -> None:
         _shared_watcher.unregister(self)
+        # flush the in-flight cycle: a short-lived profiler (tests, a
+        # worker bounce) would otherwise silently drop everything
+        # sampled since the last cycle rollover
+        with self._lock:
+            if self.current["count"]:
+                self.history.append((time(), self.current))
+                self.current = create()
+                self._last_cycle = time()
 
     # ------------------------------------------- shared-watcher callbacks
 
@@ -208,9 +223,9 @@ class Profiler:
             if self.thread_filter in (t.name or "")
         ]
 
-    def _add_sample(self, frame, now: float) -> None:
+    def _add_sample(self, frame, now: float, ident: int | None = None) -> None:
         with self._lock:
-            process(frame, self.current)
+            process(frame, self.current, stop=self.stop_file)
             if now - self._last_cycle > self.cycle:
                 self.history.append((now, self.current))
                 self.current = create()

@@ -185,6 +185,81 @@ def trace_metric_lines(trace: Any) -> list[str]:
     ]
 
 
+def selfprofile_metric_lines(wall: Any, profiler: Any = None,
+                             watchdog: Any = None) -> list[str]:
+    """Control-plane self-profiling exposition shared by both roles
+    (diagnostics/selfprofile.py; docs/observability.md
+    "Self-profiling"): the wall budget's per-phase totals, the sampler's
+    counters, and the loop watchdog's lag histogram + stall counters.
+    "Where did the scheduler's second go" is answerable from /metrics
+    alone — the profile trees add the stack detail at /profile."""
+    lines = []
+    if wall is not None:
+        first = True
+        for phase, secs in sorted(wall.snapshot().items()):
+            lines.append(
+                prom_line(
+                    "dtpu_wall_seconds_total", secs, {"phase": phase},
+                    help_="Exact monotonic wall seconds spent per "
+                          "control-plane phase (self time)"
+                    if first else None,
+                    type_="counter",
+                )
+            )
+            first = False
+        first = True
+        for phase, n in sorted(wall.snapshot_counts().items()):
+            lines.append(
+                prom_line(
+                    "dtpu_wall_phase_entries_total", n, {"phase": phase},
+                    help_="Times each control-plane phase was entered"
+                    if first else None,
+                    type_="counter",
+                )
+            )
+            first = False
+    if profiler is not None:
+        lines.append(
+            prom_line(
+                "dtpu_profile_samples_total", profiler.total_samples,
+                help_="Control-plane stack samples taken",
+                type_="counter",
+            )
+        )
+        lines.append(
+            prom_line(
+                "dtpu_profile_idle_samples_total", profiler.idle_samples,
+                help_="Samples that caught the loop idle in select() "
+                      "(counted apart from the tree)",
+                type_="counter",
+            )
+        )
+    if watchdog is not None:
+        lines.extend(
+            prom_histogram_lines(
+                "dtpu_loop_lag_seconds", watchdog.hist_lag,
+                help_="Event-loop scheduling lag per watchdog tick "
+                      "(actual gap minus the nominal interval)",
+            )
+        )
+        lines.append(
+            prom_line(
+                "dtpu_loop_ticks_total", watchdog.ticks_total,
+                help_="Stall-watchdog ticks observed on the loop",
+                type_="counter",
+            )
+        )
+        lines.append(
+            prom_line(
+                "dtpu_loop_stalls_total", watchdog.stalls_total,
+                help_="Loop stalls captured (lag beyond "
+                      "scheduler.profile.stall-threshold)",
+                type_="counter",
+            )
+        )
+    return lines
+
+
 #: exposition cap on per-link label pairs — links are O(workers^2) and
 #: a big fleet must not turn /metrics into megabytes; the top spenders
 #: by moved bytes are the ones a cost-model investigation wants
@@ -498,6 +573,13 @@ def scheduler_metrics(scheduler: Any) -> bytes:
         lines.extend(prom_histogram_lines(name, hist, help_=help_))
     lines.extend(cluster_telemetry_metric_lines(s.telemetry))
     lines.extend(trace_metric_lines(s.trace))
+    lines.extend(
+        selfprofile_metric_lines(
+            s.wall,
+            getattr(scheduler, "cp_profiler", None),
+            getattr(scheduler, "watchdog", None),
+        )
+    )
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()
 
@@ -527,5 +609,12 @@ def worker_metrics(worker: Any) -> bytes:
         lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
     lines.extend(telemetry_metric_lines(worker.telemetry))
     lines.extend(trace_metric_lines(st.trace))
+    lines.extend(
+        selfprofile_metric_lines(
+            st.wall,
+            getattr(worker, "cp_profiler", None),
+            getattr(worker, "watchdog", None),
+        )
+    )
     lines.extend(wire_metric_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -536,7 +536,13 @@ class JaxPlacement:
             loop = None
         if loop is None:
             try:
-                plan, engine_shards = self._plan_from_arrays(*snapshot)
+                # wall-budget seam (diagnostics/selfprofile.py): sync
+                # mode dispatches ON the loop thread — bill it there
+                state.wall.push("kernel.dispatch", stimulus_id)
+                try:
+                    plan, engine_shards = self._plan_from_arrays(*snapshot)
+                finally:
+                    state.wall.pop()
             except Exception:
                 logger.exception(
                     "device planning failed; disabling co-processor"
@@ -558,7 +564,20 @@ class JaxPlacement:
             # the python oracle carries the graph.
             self._executor = _DaemonExecutor("jax-placement")
         self.plans_inflight += 1
-        fut = self._executor.submit(self._plan_from_arrays, *snapshot)
+        wall = state.wall
+
+        def _plan_job(*args):
+            # wall-budget seam: the async plan bills its wall to the
+            # PLANNER thread's stack (the budget is per-thread), so the
+            # control-plane profiler's planner samples land under
+            # phase:kernel.dispatch without touching the loop's stack
+            wall.push("kernel.dispatch", stimulus_id)
+            try:
+                return self._plan_from_arrays(*args)
+            finally:
+                wall.pop()
+
+        fut = self._executor.submit(_plan_job, *snapshot)
 
         def _done(f):
             try:
@@ -583,6 +602,14 @@ class JaxPlacement:
 
         fut.add_done_callback(_done)
         return 0
+
+    def planner_ident(self) -> int | None:
+        """Thread ident of the daemon planner thread (None before the
+        first async plan spawns it) — the control-plane profiler
+        (diagnostics/selfprofile.py) samples it alongside the loop."""
+        ex = self._executor
+        thread = getattr(ex, "_thread", None) if ex is not None else None
+        return thread.ident if thread is not None else None
 
     def close(self) -> None:
         """Release the planning thread (scheduler shutdown)."""

@@ -345,6 +345,12 @@ class SchedulerMirror:
         O(dirty) scatter otherwise, a full ``device_put`` only at first
         use or after capacity growth.
         """
+        # wall-budget seam (diagnostics/selfprofile.py): refresh + H2D
+        # bill to mirror.upload on whichever thread runs the view
+        with self.state.wall.phase("mirror.upload"):
+            return self._device_view(fields)
+
+    def _device_view(self, fields: tuple[str, ...]) -> dict[str, Any] | None:
         self.refresh()
         try:
             import jax.numpy as jnp
@@ -413,6 +419,12 @@ class SchedulerMirror:
         ``None`` when jax is unavailable or the mesh cannot divide the
         capacity (callers fall back to replicated host arrays).
         """
+        with self.state.wall.phase("mirror.upload"):
+            return self._sharded_device_view(mesh, fields)
+
+    def _sharded_device_view(
+        self, mesh, fields: tuple[str, ...]
+    ) -> dict[str, Any] | None:
         self.refresh()
         try:
             import jax

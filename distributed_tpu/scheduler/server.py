@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from typing import Any, Iterable
 
 from distributed_tpu import config
@@ -247,6 +248,12 @@ class Scheduler(Server):
         self._pending_worker_msgs: dict[str, list] = {}
         self._pending_flush_scheduled = False
         self._loop: asyncio.AbstractEventLoop | None = None  # set at start
+        # control-plane self-profiling (diagnostics/selfprofile.py):
+        # wired at start_unsafe when scheduler.profile.enabled — the
+        # sampler watches the loop + planner threads, the watchdog
+        # catches loop stalls with a traceback
+        self.cp_profiler: Any | None = None
+        self.watchdog: Any | None = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -274,7 +281,42 @@ class Scheduler(Server):
                 config.get("admin.system-monitor.interval")
             ),
         )
+        # control-plane self-profiling (diagnostics/selfprofile.py;
+        # docs/observability.md "Self-profiling"): sample the event-loop
+        # thread + the jax-placement planner thread at a low rate, and
+        # watch the loop for stalls.  Wired BEFORE the HTTP server so
+        # /profile serves real trees from its first request.
+        if config.get("scheduler.profile.enabled", True):
+            from distributed_tpu.diagnostics.selfprofile import (
+                ControlPlaneProfiler,
+                LoopWatchdog,
+            )
+
+            loop_ident = threading.get_ident()  # we run ON the loop here
+            placement = self.state.placement
+
+            def _cp_idents() -> list[int]:
+                ids = [loop_ident]
+                if placement is not None:
+                    pid = getattr(placement, "planner_ident", None)
+                    pid = pid() if callable(pid) else None
+                    if pid is not None:
+                        ids.append(pid)
+                return ids
+
+            self.cp_profiler = ControlPlaneProfiler(
+                idents=_cp_idents, wall=self.state.wall
+            )
+            self.cp_profiler.start()
+            self.watchdog = LoopWatchdog(
+                trace=self.trace, wall=self.state.wall
+            )
+            self.periodic_callbacks["loop-watchdog"] = PeriodicCallback(
+                self.watchdog.tick, self.watchdog.interval
+            )
+            self.watchdog.start(loop_ident)
         if self._http_port is not None:
+            from distributed_tpu.diagnostics.selfprofile import profile_jsonl
             from distributed_tpu.http.dashboard import json_api_routes
 
             from distributed_tpu.tracing import to_jsonl
@@ -297,6 +339,16 @@ class Scheduler(Server):
                     # RTTs, divergence summary (telemetry.py)
                     "/telemetry": lambda: (
                         to_jsonl(self.state.telemetry.snapshot()),
+                        "application/x-ndjson",
+                    ),
+                    # control-plane self-profile: wall budget + sampled
+                    # loop/planner trees + recent stalls as JSONL
+                    # (docs/observability.md "Self-profiling")
+                    "/profile": lambda: (
+                        profile_jsonl(
+                            "scheduler", self.cp_profiler,
+                            self.state.wall, self.watchdog,
+                        ),
                         "application/x-ndjson",
                     ),
                     **json_api_routes(self),
@@ -347,6 +399,10 @@ class Scheduler(Server):
         logger.info("closing scheduler %s", self.id)
         for pc in self.periodic_callbacks.values():
             pc.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.cp_profiler is not None:
+            self.cp_profiler.stop()  # flushes the in-flight cycle
         placement = self.state.placement
         if placement is not None and hasattr(placement, "close"):
             placement.close()
@@ -414,6 +470,15 @@ class Scheduler(Server):
         client_msgs, self._pending_client_msgs = self._pending_client_msgs, {}
         worker_msgs, self._pending_worker_msgs = self._pending_worker_msgs, {}
         tr = self.trace
+        wall = self.state.wall
+        wall.push("egress.flush")
+        try:
+            self._flush_payloads(client_msgs, worker_msgs, tr)
+        finally:
+            wall.pop()
+
+    def _flush_payloads(self, client_msgs: dict, worker_msgs: dict,
+                        tr: Any) -> None:
         for client, msgs in client_msgs.items():
             bs = self.client_comms.get(client)
             if bs is None:
@@ -609,12 +674,13 @@ class Scheduler(Server):
         # per-prefix priors
         tel = self.state.telemetry
         if tel.enabled:
-            if link_telemetry:
-                tel.fold_rows(link_telemetry, reporter=address)
-            if rtt:
-                tel.record_rtt(address, rtt)
-            if fine_metrics:
-                tel.fold_fine_rows(fine_metrics)
+            with self.state.wall.phase("telemetry.fold"):
+                if link_telemetry:
+                    tel.fold_rows(link_telemetry, reporter=address)
+                if rtt:
+                    tel.record_rtt(address, rtt)
+                if fine_metrics:
+                    tel.fold_fine_rows(fine_metrics)
         # reconcile pause state: the event message can be lost at
         # startup (see Worker.heartbeat) and a stale "running" view
         # pins the paused worker's tasks out of stealing forever.
@@ -1781,6 +1847,24 @@ class Scheduler(Server):
             scheduler_info["transition_log"] = [
                 list(row) for row in list(s.transition_log)[-5000:]
             ]
+        if "profile" not in (exclude or ()):
+            # the self-profile tail travels with the dump: a postmortem
+            # can see where the scheduler's wall went (phase budget),
+            # the sampled control-plane tree, and any stall captures —
+            # without a live cluster (docs/observability.md)
+            prof: dict[str, Any] = {
+                "wall_seconds": {
+                    k: round(v, 6) for k, v in s.wall.snapshot().items()
+                },
+            }
+            if self.cp_profiler is not None:
+                prof["samples_total"] = self.cp_profiler.total_samples
+                prof["idle_samples"] = self.cp_profiler.idle_samples
+                prof["tree"] = self.cp_profiler.get_profile()
+            if self.watchdog is not None:
+                prof["stalls_total"] = self.watchdog.stalls_total
+                prof["stalls"] = list(self.watchdog.stalls)
+            scheduler_info["profile"] = prof
         out = {"scheduler": scheduler_info}
         if "flight_recorder" not in (exclude or ()):
             # every node's causal tail ships in the dump by default
@@ -1840,19 +1924,31 @@ class Scheduler(Server):
         return self.task_stream.collect(start=start, count=count)
 
     async def get_profile(self, workers: list[str] | None = None,
-                          start: float | None = None) -> Any:
-        """Merged worker profiles (reference scheduler.py:7991)."""
+                          start: float | None = None,
+                          scope: str = "all") -> Any:
+        """Merged profiles (reference scheduler.py:7991), with the
+        scheduler's own control-plane tree in the merge.
+
+        ``scope``: ``"workers"`` — executor trees from the fleet only
+        (the pre-self-profiling behavior); ``"scheduler"`` — this
+        process's control-plane tree only (no broadcast); ``"all"``
+        (default) — both merged."""
         from distributed_tpu.diagnostics.profile import merge
         from distributed_tpu.protocol.serialize import unwrap
 
-        resp = await self.broadcast(
-            msg={"op": "profile", "start": start}, workers=workers
-        )
+        if scope not in ("workers", "scheduler", "all"):
+            raise ValueError(f"unknown profile scope {scope!r}")
         trees = []
-        for v in resp.values():
-            v = unwrap(v)
-            if isinstance(v, dict) and "count" in v:
-                trees.append(v)
+        if scope in ("workers", "all"):
+            resp = await self.broadcast(
+                msg={"op": "profile", "start": start}, workers=workers
+            )
+            for v in resp.values():
+                v = unwrap(v)
+                if isinstance(v, dict) and "count" in v:
+                    trees.append(v)
+        if scope in ("scheduler", "all") and self.cp_profiler is not None:
+            trees.append(self.cp_profiler.get_profile(start=start))
         return merge(*trees)
 
     async def get_events_handler(self, topic: str | None = None) -> Any:

@@ -42,6 +42,7 @@ from distributed_tpu.exceptions import (
     NoValidWorkerError,
     TransitionCounterMaxExceeded,
 )
+from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.graph.spec import TaskSpec
 from distributed_tpu.protocol.serialize import compact_frames, wrap_opaque
 from distributed_tpu.telemetry import ClusterTelemetry
@@ -443,6 +444,19 @@ class SchedulerState:
         # the mirror emit through them during the rest of this __init__
         self.trace = FlightRecorder()
         self.trace.clock = self.clock
+        # wall-budget phase attribution (diagnostics/selfprofile.py;
+        # docs/observability.md "Self-profiling"): exact monotonic
+        # accumulators entered at the hot-path seams.  Always REAL
+        # monotonic time, even under the simulator's virtual clock —
+        # the budget measures python cost, not simulated time.
+        self.wall = WallBudget()
+        # per-transition-arm attribution (engine.scalar-arm:<s>,<f>):
+        # opt-in — two monotonic reads per transition are not free on
+        # the flood path, so sim.profile_run turns it on explicitly
+        self.WALL_ARMS: bool = bool(
+            config.get("scheduler.profile.arm-attribution", False)
+        )
+        self._arm_phases: dict[tuple[str, str], str] = {}
         # recommendations per engine pass / flood fold size
         self.hist_engine_batch = Histogram(SIZE_BUCKETS)
         # wall seconds per engine pass (one flood fold or one
@@ -661,49 +675,71 @@ class SchedulerState:
                 raise TransitionCounterMaxExceeded(key, start, finish, self.story(key))
         self.transition_counter += 1
 
-        func = self._transitions_table.get((start, finish))
-        if func is not None:
-            recommendations, client_msgs, worker_msgs = func(
-                key, stimulus_id=stimulus_id, **kwargs
-            )
-        elif "released" not in (start, finish):
-            # untable'd pair: route through released (reference scheduler.py:1961)
-            assert not kwargs, (kwargs, start, finish)
-            a_recs, a_cmsgs, a_wmsgs = self._transition(key, "released", stimulus_id)
-            v = a_recs.get(key, finish)
-            func = self._transitions_table.get(("released", v))
-            if func is None:
+        # opt-in per-arm wall attribution (sim.profile_run's table):
+        # everything from dispatch through log/trace/plugins bills to
+        # this (start, finish) arm; a routed pair's released leg nests
+        # its own arm, so self-time stays exact
+        arms = self.WALL_ARMS
+        if arms:
+            self.wall.push(self._arm_phase(start, finish), stimulus_id)
+        try:
+            func = self._transitions_table.get((start, finish))
+            if func is not None:
+                recommendations, client_msgs, worker_msgs = func(
+                    key, stimulus_id=stimulus_id, **kwargs
+                )
+            elif "released" not in (start, finish):
+                # untable'd pair: route through released (reference scheduler.py:1961)
+                assert not kwargs, (kwargs, start, finish)
+                a_recs, a_cmsgs, a_wmsgs = self._transition(key, "released", stimulus_id)
+                v = a_recs.get(key, finish)
+                func = self._transitions_table.get(("released", v))
+                if func is None:
+                    raise InvalidTransition(key, start, finish, self.story(key))
+                b_recs, b_cmsgs, b_wmsgs = func(key, stimulus_id=stimulus_id)
+                recommendations = {**a_recs, **b_recs}
+                client_msgs = _merge_msgs(a_cmsgs, b_cmsgs)
+                worker_msgs = _merge_msgs(a_wmsgs, b_wmsgs)
+                start = "released"
+            else:
                 raise InvalidTransition(key, start, finish, self.story(key))
-            b_recs, b_cmsgs, b_wmsgs = func(key, stimulus_id=stimulus_id)
-            recommendations = {**a_recs, **b_recs}
-            client_msgs = _merge_msgs(a_cmsgs, b_cmsgs)
-            worker_msgs = _merge_msgs(a_wmsgs, b_wmsgs)
-            start = "released"
-        else:
-            raise InvalidTransition(key, start, finish, self.story(key))
 
-        actual_finish = ts.state
-        self.transition_log.append(
-            (key, start, actual_finish, dict(recommendations), stimulus_id, self.clock())
-        )
-        # task-level trace hop (sampled 1-in-N): name=finish, dest=start
-        # — interned strings only, so the flood fast path allocates
-        # nothing (the bench-smoke "trace" gate enforces both the alloc
-        # contract and the <5% traced-on overhead)
-        self.trace.emit_task(
-            "transition", actual_finish, stimulus_id, key=key, dest=start
-        )
-        if self.validate:
-            self.validate_task_state(ts)
-        if self.plugins:
-            for plugin in list(self.plugins.values()):
-                try:
-                    plugin.transition(
-                        key, start, actual_finish, stimulus_id=stimulus_id, **kwargs
-                    )
-                except Exception:
-                    logger.exception("Plugin %r failed in transition", plugin)
-        return recommendations, client_msgs, worker_msgs
+            actual_finish = ts.state
+            self.transition_log.append(
+                (key, start, actual_finish, dict(recommendations), stimulus_id, self.clock())
+            )
+            # task-level trace hop (sampled 1-in-N): name=finish, dest=start
+            # — interned strings only, so the flood fast path allocates
+            # nothing (the bench-smoke "trace" gate enforces both the alloc
+            # contract and the <5% traced-on overhead)
+            self.trace.emit_task(
+                "transition", actual_finish, stimulus_id, key=key, dest=start
+            )
+            if self.validate:
+                self.validate_task_state(ts)
+            if self.plugins:
+                for plugin in list(self.plugins.values()):
+                    try:
+                        plugin.transition(
+                            key, start, actual_finish, stimulus_id=stimulus_id, **kwargs
+                        )
+                    except Exception:
+                        logger.exception("Plugin %r failed in transition", plugin)
+            return recommendations, client_msgs, worker_msgs
+        finally:
+            if arms:
+                self.wall.pop()
+
+    def _arm_phase(self, start: str, finish: str) -> str:
+        """Interned wall-budget phase name for one transition arm —
+        built once per (start, finish) pair so the opt-in hot path never
+        formats strings per transition."""
+        p = self._arm_phases.get((start, finish))
+        if p is None:
+            p = self._arm_phases[(start, finish)] = (
+                f"engine.scalar-arm:{start},{finish}"
+            )
+        return p
 
     def _transitions(
         self,
@@ -749,7 +785,11 @@ class SchedulerState:
         client_msgs: dict = {}
         worker_msgs: dict = {}
         t0 = self.clock()
-        self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+        self.wall.push("engine.drain", stimulus_id)
+        try:
+            self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+        finally:
+            self.wall.pop()
         # histograms observe regardless of trace.enabled: dtpu_engine_*
         # are documented /metrics families, not trace output
         n = len(recommendations)
@@ -2268,6 +2308,7 @@ class SchedulerState:
             # failure per message, the rest of the payload proceeds):
             # a poison round must not discard the messages of rounds
             # already applied to state
+            self.wall.push("engine.drain", stimulus_id)
             try:
                 self._transitions(
                     dict(recommendations), client_msgs, worker_msgs, stimulus_id
@@ -2277,6 +2318,8 @@ class SchedulerState:
                     "batched transition round failed (stimulus %s)",
                     stimulus_id,
                 )
+            finally:
+                self.wall.pop()
             n = len(recommendations)
             self.hist_engine_batch.observe(n)
             self.hist_engine_pass.observe(self.clock() - t0)
@@ -2303,57 +2346,61 @@ class SchedulerState:
             finishes = list(finishes)
         tr = self.trace
         t0 = self.clock()
-        for key, worker, stimulus_id, kwargs in finishes:
-            if tr.journal_enabled:
-                tr.record(
-                    "task-finished",
-                    {"key": key, "worker": worker, "kwargs": dict(kwargs)},
-                    stimulus_id,
-                )
-            # per-event fault isolation, same as the per-message path
-            # (handle_stream logs one failure and proceeds): a poison
-            # event must not discard the flood's already-accumulated
-            # messages — transitions behind them are already applied
-            try:
-                ts = self.tasks.get(key)
-                if ts is None or ts.state in ("released", "forgotten", "erred"):
-                    # stale completion for a cancelled task: tell worker
-                    # to drop it (merged per destination at flush time)
-                    worker_msgs.setdefault(worker, []).append(
-                        {
-                            "op": "free-keys",
-                            "keys": [key],
-                            "stimulus_id": stimulus_id,
-                        }
+        self.wall.push("engine.drain", finishes[0][2] if finishes else "")
+        try:
+            for key, worker, stimulus_id, kwargs in finishes:
+                if tr.journal_enabled:
+                    tr.record(
+                        "task-finished",
+                        {"key": key, "worker": worker, "kwargs": dict(kwargs)},
+                        stimulus_id,
                     )
-                    continue
-                if ts.state == "memory":
-                    ws = self.workers.get(worker)
-                    if ws is not None and ws not in ts.who_has:
-                        self.add_replica(ts, ws)
-                    continue
-                if ts.state != "processing":
-                    continue
-                ts.metadata = kwargs.pop("metadata", None) or ts.metadata
-                recs, cmsgs, wmsgs = self._transition(
-                    key, "memory", stimulus_id, worker=worker, **kwargs
-                )
-                _merge_msgs_inplace(client_msgs, cmsgs)
-                _merge_msgs_inplace(worker_msgs, wmsgs)
-                self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
-                if self.queued:
-                    # the per-key engine runs this pass per event; it is
-                    # a no-op on an empty queue, so gating on ``queued``
-                    # folds the common case without changing any outcome
-                    recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
-                    self._transitions(
-                        recs2, client_msgs, worker_msgs, stimulus_id
+                # per-event fault isolation, same as the per-message path
+                # (handle_stream logs one failure and proceeds): a poison
+                # event must not discard the flood's already-accumulated
+                # messages — transitions behind them are already applied
+                try:
+                    ts = self.tasks.get(key)
+                    if ts is None or ts.state in ("released", "forgotten", "erred"):
+                        # stale completion for a cancelled task: tell worker
+                        # to drop it (merged per destination at flush time)
+                        worker_msgs.setdefault(worker, []).append(
+                            {
+                                "op": "free-keys",
+                                "keys": [key],
+                                "stimulus_id": stimulus_id,
+                            }
+                        )
+                        continue
+                    if ts.state == "memory":
+                        ws = self.workers.get(worker)
+                        if ws is not None and ws not in ts.who_has:
+                            self.add_replica(ts, ws)
+                        continue
+                    if ts.state != "processing":
+                        continue
+                    ts.metadata = kwargs.pop("metadata", None) or ts.metadata
+                    recs, cmsgs, wmsgs = self._transition(
+                        key, "memory", stimulus_id, worker=worker, **kwargs
                     )
-            except Exception:
-                logger.exception(
-                    "batched task-finished event failed (%s from %s, "
-                    "stimulus %s)", key, worker, stimulus_id,
-                )
+                    _merge_msgs_inplace(client_msgs, cmsgs)
+                    _merge_msgs_inplace(worker_msgs, wmsgs)
+                    self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+                    if self.queued:
+                        # the per-key engine runs this pass per event; it is
+                        # a no-op on an empty queue, so gating on ``queued``
+                        # folds the common case without changing any outcome
+                        recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+                        self._transitions(
+                            recs2, client_msgs, worker_msgs, stimulus_id
+                        )
+                except Exception:
+                    logger.exception(
+                        "batched task-finished event failed (%s from %s, "
+                        "stimulus %s)", key, worker, stimulus_id,
+                    )
+        finally:
+            self.wall.pop()
         if finishes:
             self.hist_engine_batch.observe(len(finishes))
             self.hist_engine_pass.observe(self.clock() - t0)
@@ -2376,40 +2423,44 @@ class SchedulerState:
             errors = list(errors)
         tr = self.trace
         t0 = self.clock()
-        for key, worker, stimulus_id, kwargs in errors:
-            if tr.journal_enabled:
-                tr.record(
-                    "task-erred",
-                    {"key": key, "worker": worker, "kwargs": dict(kwargs)},
-                    stimulus_id,
-                )
-            try:
-                ts = self.tasks.get(key)
-                if ts is None or ts.state != "processing":
-                    continue
-                if ts.processing_on is None or ts.processing_on.address != worker:
-                    continue
-                recs, cmsgs, wmsgs = self._transition(
-                    key,
-                    "erred",
-                    stimulus_id,
-                    cause=key,
-                    worker=worker,
-                    **kwargs,
-                )
-                _merge_msgs_inplace(client_msgs, cmsgs)
-                _merge_msgs_inplace(worker_msgs, wmsgs)
-                self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
-                if self.queued:
-                    recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
-                    self._transitions(
-                        recs2, client_msgs, worker_msgs, stimulus_id
+        self.wall.push("engine.drain", errors[0][2] if errors else "")
+        try:
+            for key, worker, stimulus_id, kwargs in errors:
+                if tr.journal_enabled:
+                    tr.record(
+                        "task-erred",
+                        {"key": key, "worker": worker, "kwargs": dict(kwargs)},
+                        stimulus_id,
                     )
-            except Exception:
-                logger.exception(
-                    "batched task-erred event failed (%s from %s, "
-                    "stimulus %s)", key, worker, stimulus_id,
-                )
+                try:
+                    ts = self.tasks.get(key)
+                    if ts is None or ts.state != "processing":
+                        continue
+                    if ts.processing_on is None or ts.processing_on.address != worker:
+                        continue
+                    recs, cmsgs, wmsgs = self._transition(
+                        key,
+                        "erred",
+                        stimulus_id,
+                        cause=key,
+                        worker=worker,
+                        **kwargs,
+                    )
+                    _merge_msgs_inplace(client_msgs, cmsgs)
+                    _merge_msgs_inplace(worker_msgs, wmsgs)
+                    self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+                    if self.queued:
+                        recs2 = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+                        self._transitions(
+                            recs2, client_msgs, worker_msgs, stimulus_id
+                        )
+                except Exception:
+                    logger.exception(
+                        "batched task-erred event failed (%s from %s, "
+                        "stimulus %s)", key, worker, stimulus_id,
+                    )
+        finally:
+            self.wall.pop()
         if errors:
             self.hist_engine_batch.observe(len(errors))
             self.hist_engine_pass.observe(self.clock() - t0)

@@ -57,6 +57,9 @@ CATEGORIES = (
     "wstim",       # a worker state-machine stimulus (task-level, sampled)
     "shadow",      # a shadow cost-model divergence sample (task-level,
                    # sampled; telemetry.py — n = ratio in permille)
+    "stall",       # the stall watchdog caught a blocked event loop
+                   # (diagnostics/selfprofile.py — key = formatted
+                   # traceback, name = in-progress phase, n = lag ms)
 )
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import sys
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -288,6 +289,12 @@ class Worker(Server):
                 idents=idents,
                 active=lambda: bool(self.state.executing),
             )
+        # control-plane self-profiling (diagnostics/selfprofile.py):
+        # this worker's EVENT-LOOP thread — the gather_dep/execute
+        # dispatch plane the executor profiler above cannot see.  Wired
+        # at start_unsafe (the loop ident is only known there).
+        self.cp_profiler: Any | None = None
+        self.watchdog: Any | None = None
         self.memory_manager = None
         if memory_limit:
             from distributed_tpu.worker.memory import WorkerMemoryManager
@@ -348,7 +355,27 @@ class Worker(Server):
                 config.get("admin.system-monitor.interval")
             ),
         )
+        # control-plane self-profiling: sample this worker's loop thread
+        # + stall watchdog (same scheduler.profile subtree as the
+        # scheduler's, like the shared trace config)
+        if config.get("scheduler.profile.enabled", True):
+            from distributed_tpu.diagnostics.selfprofile import (
+                ControlPlaneProfiler,
+                LoopWatchdog,
+            )
+
+            loop_ident = threading.get_ident()  # we run ON the loop here
+            self.cp_profiler = ControlPlaneProfiler(
+                idents=lambda: [loop_ident], wall=self.state.wall
+            )
+            self.cp_profiler.start()
+            self.watchdog = LoopWatchdog(trace=self.trace, wall=self.state.wall)
+            self.periodic_callbacks["loop-watchdog"] = PeriodicCallback(
+                self.watchdog.tick, self.watchdog.interval
+            )
+            self.watchdog.start(loop_ident)
         if self._http_port is not None:
+            from distributed_tpu.diagnostics.selfprofile import profile_jsonl
             from distributed_tpu.tracing import to_jsonl
 
             self.http_server = HTTPServer(
@@ -367,6 +394,20 @@ class Worker(Server):
                     # (telemetry.py; docs/observability.md)
                     "/telemetry": lambda: (
                         to_jsonl(self.telemetry.snapshot()),
+                        "application/x-ndjson",
+                    ),
+                    # control-plane self-profile (loop tree + wall
+                    # budget + stalls) plus the executor profile tree
+                    # (docs/observability.md "Self-profiling")
+                    "/profile": lambda: (
+                        profile_jsonl(
+                            "worker", self.cp_profiler, self.state.wall,
+                            self.watchdog,
+                            extra_trees=(
+                                {"exec": self.profiler.get_profile()}
+                                if self.profiler is not None else None
+                            ),
+                        ),
                         "application/x-ndjson",
                     ),
                 },
@@ -551,6 +592,10 @@ class Worker(Server):
             await self.scheduler_comm.close()
         if self.profiler is not None:
             self.profiler.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.cp_profiler is not None:
+            self.cp_profiler.stop()  # flushes the in-flight cycle
         self.executor.shutdown(wait=False)
         self.actor_executor.shutdown(wait=False)
         if hasattr(self.data, "close"):

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from distributed_tpu import config
+from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.exceptions import InvalidTaskState, InvalidTransition
 from distributed_tpu.tracing import FlightRecorder
 from distributed_tpu.utils import HeapSet
@@ -479,6 +480,16 @@ class WorkerState:
         self.trace = FlightRecorder()
         if clock is not None:
             self.trace.clock = clock
+        # wall-budget phase attribution (diagnostics/selfprofile.py):
+        # ``wengine.stimulus`` per handle_stimulus batch, plus opt-in
+        # ``wengine.scalar-arm:<start>,<finish>`` arms — always REAL
+        # monotonic time (python cost, not virtual time), so the
+        # injectable clock above deliberately does not re-point it
+        self.wall = WallBudget()
+        self.WALL_ARMS: bool = bool(
+            config.get("scheduler.profile.arm-attribution", False)
+        )
+        self._arm_phases: dict[tuple[str, str], str] = {}
         self.rng = random.Random(0)  # deterministic (reference wsm.py:1328)
         self.task_counter: defaultdict[str, int] = defaultdict(int)
 
@@ -570,22 +581,48 @@ class WorkerState:
         bench, with per-request loop cost dwarfing the payload)."""
         instructions: Instructions = []
         tr = self.trace
-        for event in events:
-            self.stimulus_log.append(event)
-            # task-level trace hop (sampled): the payload-boundary batch
-            # arrives as one handle_stimulus call, so each event's
-            # stimulus id joins the scheduler envelope that carried it
-            tr.emit_task("wstim", type(event).__name__, event.stimulus_id)
-            handler = getattr(self, "_handle_" + _snake(type(event).__name__))
-            recs, instr = handler(event)
-            instructions += instr
-            instructions += self._transitions(recs, stimulus_id=event.stimulus_id)
-        stimulus_id = events[-1].stimulus_id if events else "ensure"
-        instructions += self._ensure_computing(stimulus_id)
-        instructions += self._ensure_communicating(stimulus_id)
-        if self.validate:
-            self.validate_state()
-        return instructions
+        self.wall.push(
+            "wengine.stimulus", events[0].stimulus_id if events else ""
+        )
+        # arm-attribution mode also breaks out the event-handler bodies
+        # and ensure drains, so the worker half of sim.profile_run's
+        # table names every compiled-core candidate, not only the arms
+        arms = self.WALL_ARMS
+        wall = self.wall
+        try:
+            for event in events:
+                self.stimulus_log.append(event)
+                # task-level trace hop (sampled): the payload-boundary batch
+                # arrives as one handle_stimulus call, so each event's
+                # stimulus id joins the scheduler envelope that carried it
+                tr.emit_task("wstim", type(event).__name__, event.stimulus_id)
+                handler = getattr(self, "_handle_" + _snake(type(event).__name__))
+                if arms:
+                    wall.push(
+                        self._handler_phase(type(event).__name__),
+                        event.stimulus_id,
+                    )
+                try:
+                    recs, instr = handler(event)
+                finally:
+                    if arms:
+                        wall.pop()
+                instructions += instr
+                instructions += self._transitions(recs, stimulus_id=event.stimulus_id)
+            stimulus_id = events[-1].stimulus_id if events else "ensure"
+            if arms:
+                with wall.phase("wengine.ensure-computing", stimulus_id):
+                    instructions += self._ensure_computing(stimulus_id)
+                with wall.phase("wengine.ensure-communicating", stimulus_id):
+                    instructions += self._ensure_communicating(stimulus_id)
+            else:
+                instructions += self._ensure_computing(stimulus_id)
+                instructions += self._ensure_communicating(stimulus_id)
+            if self.validate:
+                self.validate_state()
+            return instructions
+        finally:
+            wall.pop()
 
     # -------------------------------------------------------- event handlers
 
@@ -985,33 +1022,60 @@ class WorkerState:
         if start == finish:
             return {}, []
         self.transition_counter += 1
-        func = self._transitions_table.get((start, finish))
-        if func is not None:
-            recs, instructions = func(ts, stimulus_id=stimulus_id, **kwargs)
-            self.log.append((ts.key, start, ts.state, stimulus_id))
-            return recs, instructions
-        if "released" not in (start, finish):
-            # no direct edge: route start -> released -> finish, replaying
-            # any intermediate recommendations for ts along the way but
-            # never forgetting it (reference wsm.py:2602-2629)
-            recs, instructions = self._do_transition(
-                ts, "released", stimulus_id
+        # opt-in per-arm wall attribution (sim.profile_run's table);
+        # routed pairs nest their released-leg arms, so self-time is
+        # exact — mirrors SchedulerState._transition
+        arms = self.WALL_ARMS
+        if arms:
+            self.wall.push(self._arm_phase(start, str(finish)), stimulus_id)
+        try:
+            func = self._transitions_table.get((start, finish))
+            if func is not None:
+                recs, instructions = func(ts, stimulus_id=stimulus_id, **kwargs)
+                self.log.append((ts.key, start, ts.state, stimulus_id))
+                return recs, instructions
+            if "released" not in (start, finish):
+                # no direct edge: route start -> released -> finish, replaying
+                # any intermediate recommendations for ts along the way but
+                # never forgetting it (reference wsm.py:2602-2629)
+                recs, instructions = self._do_transition(
+                    ts, "released", stimulus_id
+                )
+                while (v := recs.pop(ts, None)) is not None:
+                    v_state = v[0] if isinstance(v, tuple) else v
+                    if v_state == "forgotten":
+                        continue
+                    r2, i2 = self._do_transition(ts, v, stimulus_id)
+                    recs.update(r2)
+                    instructions += i2
+                r3, i3 = self._do_transition(
+                    ts, (finish, kwargs["payload"]) if kwargs else finish,
+                    stimulus_id,
+                )
+                recs.update(r3)
+                instructions += i3
+                return recs, instructions
+            raise InvalidTransition(ts.key, start, str(finish), list(self.log))
+        finally:
+            if arms:
+                self.wall.pop()
+
+    def _arm_phase(self, start: str, finish: str) -> str:
+        """Interned wall-budget phase name for one worker transition arm."""
+        p = self._arm_phases.get((start, finish))
+        if p is None:
+            p = self._arm_phases[(start, finish)] = (
+                f"wengine.scalar-arm:{start},{finish}"
             )
-            while (v := recs.pop(ts, None)) is not None:
-                v_state = v[0] if isinstance(v, tuple) else v
-                if v_state == "forgotten":
-                    continue
-                r2, i2 = self._do_transition(ts, v, stimulus_id)
-                recs.update(r2)
-                instructions += i2
-            r3, i3 = self._do_transition(
-                ts, (finish, kwargs["payload"]) if kwargs else finish,
-                stimulus_id,
-            )
-            recs.update(r3)
-            instructions += i3
-            return recs, instructions
-        raise InvalidTransition(ts.key, start, str(finish), list(self.log))
+        return p
+
+    def _handler_phase(self, event_name: str) -> str:
+        """Interned phase name for one stimulus-handler body."""
+        key = (event_name, "")
+        p = self._arm_phases.get(key)
+        if p is None:
+            p = self._arm_phases[key] = f"wengine.handler:{event_name}"
+        return p
 
     # ------------------------------------------------------------- handlers
 

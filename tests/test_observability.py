@@ -982,12 +982,25 @@ def test_metrics_names_unique_and_documented():
 
     from distributed_tpu.telemetry import LinkTelemetry
 
+    from distributed_tpu.diagnostics.selfprofile import (
+        ControlPlaneProfiler,
+        LoopWatchdog,
+    )
+
     class _Stealing:
         count = 3
 
     class _Sched:
         state = SchedulerState()
         extensions = {"stealing": _Stealing()}
+        # self-profiling plane (diagnostics/selfprofile.py): the parity
+        # gate must cover dtpu_wall_/dtpu_profile_/dtpu_loop_ families
+        cp_profiler = ControlPlaneProfiler(idents=lambda: [])
+        watchdog = LoopWatchdog()
+
+    _Sched.watchdog.tick()
+    with _Sched.state.wall.phase("engine.drain", "pm-stim"):
+        pass
 
     # one task so the labeled per-state samples are exercised
     _Sched.state.new_task("metrics-k", None)
@@ -1034,8 +1047,12 @@ def test_metrics_names_unique_and_documented():
         data = _SpillDict()
         get_data_wire_bytes = 0
         telemetry = LinkTelemetry()
+        cp_profiler = ControlPlaneProfiler(idents=lambda: [])
+        watchdog = LoopWatchdog()
 
     _Worker.telemetry.record("tcp://pm:2", "tcp://pm:3", 1000, 0.001)
+    with _Worker.state.wall.phase("wengine.stimulus", "pm-stim"):
+        pass
 
     repo = Path(__file__).resolve().parent.parent
     docs = (repo / "docs/observability.md").read_text()
@@ -1092,7 +1109,16 @@ def test_metrics_names_unique_and_documented():
             "dtpu_mirror_shard_bytes_uploaded_total",
             "dtpu_mirror_shard_full_packs_total",
             "dtpu_engine_shard_kernel_ms",
-            "dtpu_engine_shard_h2d_bytes_total"} <= all_names
+            "dtpu_engine_shard_h2d_bytes_total",
+            "dtpu_wall_seconds_total",
+            "dtpu_wall_phase_entries_total",
+            "dtpu_profile_samples_total",
+            "dtpu_profile_idle_samples_total",
+            "dtpu_loop_lag_seconds_bucket",
+            "dtpu_loop_lag_seconds_sum",
+            "dtpu_loop_lag_seconds_count",
+            "dtpu_loop_ticks_total",
+            "dtpu_loop_stalls_total"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
         f"metrics missing from the docs/observability.md table: "
