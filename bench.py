@@ -1941,6 +1941,229 @@ def _smoke_sim() -> dict:
     }
 
 
+async def _smoke_ledger_live() -> dict:
+    """Join-correctness half of the ledger gate on a SMALL LIVE
+    cluster: a real flood + a dependent graph over real tcp must leave
+    every placement decision joined to a realized outcome (ledger.py)
+    with regret observed — the live counterpart of the simulator's
+    exact-join tests."""
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+    from distributed_tpu.graph.spec import Graph, TaskRef, TaskSpec
+
+    async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.gather(c.map(_inc, range(60)))
+            g = Graph()
+            for i in range(16):
+                g.tasks[f"lsrc-{i}"] = TaskSpec(_inc, (i,))
+                g.tasks[f"ldep-{i}"] = TaskSpec(
+                    _inc, (TaskRef(f"lsrc-{i}"),)
+                )
+            g.tasks["lroot"] = TaskSpec(
+                _sum_list, ([TaskRef(f"ldep-{i}") for i in range(16)],)
+            )
+            futs = c.compute_graph(g, ["lroot"])
+            result = await futs["lroot"].result()
+            assert result == sum(range(16)) + 32, result
+            led = cluster.scheduler.state.ledger
+            summary = led.summary()
+            # ...and the RPC/HTTP surface serves the same snapshot
+            rpc_snap = await c.scheduler.get_ledger(n=10)
+    assert summary["joined"] >= 60, summary
+    assert summary["unjoined"] == 0, summary
+    assert summary["open"] == 0, summary
+    assert summary["outcomes"].get("memory", 0) >= 60, summary
+    n_regret = sum(k["count"] for k in summary["kinds"].values())
+    assert n_regret > 0, summary
+    assert rpc_snap and rpc_snap[0]["type"] == "ledger-summary"
+    return {
+        "live_joined": summary["joined"],
+        "live_unjoined": summary["unjoined"],
+        "live_regret_rows": n_regret,
+    }
+
+
+def _smoke_ledger() -> dict:
+    """Decision-ledger gate (ledger.py, diagnostics/critical_path.py;
+    docs/observability.md "Decision ledger & critical-path").  Raises if
+
+    - ledger-on vs -off engine-flood overhead exceeds 5% (min-per-pair-
+      ratio estimator, the drift-robust A/B from the trace smoke),
+    - the steady-state file+join hot path allocates (PR 6's
+      ``sys.getallocatedblocks`` gate pattern),
+    - a small LIVE cluster leaves any decision unjoined (above),
+    - on a telemetry-seeded NON-UNIFORM simulated fleet the measured-
+      shadow model's aggregate |regret| is not lower than the
+      constants' — the ROADMAP item 1 calibration artifact,
+    - critical-path attribution does not sum to the sim run's virtual
+      makespan within 1% (``critical_path.check``).
+    """
+    import asyncio
+    import sys as _sys
+
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.graph.spec import TaskSpec
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    N_WORKERS, N_TASKS, REPS = 16, 2000, 7
+
+    def build(enabled):
+        with dtpu_config.set({"scheduler.ledger.enabled": enabled}):
+            state = SchedulerState(validate=False)
+        for i in range(N_WORKERS):
+            state.add_worker_state(
+                f"tcp://led:{i}", nthreads=2, memory_limit=2**30,
+                name=f"l{i}",
+            )
+        tasks = {f"led-{i}": TaskSpec(_inc, (i,)) for i in range(N_TASKS)}
+        deps: dict = {f"led-{i}": set() for i in range(N_TASKS)}
+        for i in range(0, N_TASKS, 4):
+            tasks[f"ldp-{i}"] = TaskSpec(_inc, (i,))
+            deps[f"ldp-{i}"] = {f"led-{i}", f"led-{(i + 1) % N_TASKS}"}
+        state.update_graph_core(
+            tasks, deps, list(tasks), client="smoke",
+            stimulus_id="smoke-ledger-graph",
+        )
+        return state
+
+    # live task-finished messages ALWAYS carry startstops (the worker
+    # stamps every compute): the flood includes them so the baseline is
+    # the real ingest path — prefix duration folds, group timing — not
+    # an artificially thin engine pass
+    SS = ({"action": "compute", "start": 0.0, "stop": 0.005},)
+
+    def flood(state) -> float:
+        t0 = time.perf_counter()
+        rounds = 0
+        while True:
+            batch = [
+                (
+                    ts.key, ws.address, f"led-fin-{ts.key}",
+                    {"nbytes": 8, "startstops": SS},
+                )
+                for ws in state.workers.values()
+                for ts in list(ws.processing)
+            ]
+            if not batch:
+                break
+            state.stimulus_tasks_finished_batch(batch)
+            rounds += 1
+            assert rounds < 10 * N_TASKS, "flood did not converge"
+        return time.perf_counter() - t0
+
+    flood(build(True))   # untimed warmup per arm (allocator/code warm)
+    flood(build(False))
+    on_walls, off_walls = [], []
+    for _ in range(REPS):
+        on_walls.append(flood(build(True)))
+        off_walls.append(flood(build(False)))
+    min_ratio = min(on / off for on, off in zip(on_walls, off_walls))
+    overhead_pct = max(0.0, (min_ratio - 1.0) * 100)
+    assert overhead_pct < 5.0, (
+        f"ledger-on overhead {overhead_pct:.1f}% exceeds the 5% budget "
+        f"(on={on_walls}, off={off_walls})"
+    )
+
+    # allocation contract on the file+join hot path: steady-state
+    # decision rows allocate nothing net (preallocated slots + dict
+    # insert/pop pairs).  Warm a FULL ring wrap first — the first pass
+    # retires each slot's shared initial constants — plus the aggregate
+    # dicts (prefix/link/kind/histogram entries are one-time).
+    import gc
+
+    from distributed_tpu.ledger import DecisionLedger
+
+    led = DecisionLedger(size=16384, enabled=True)
+    keys = [f"alloc-{i}" for i in range(64)]
+    wraps = (led._mask + 2) // len(keys) + 2
+
+    def cycle():
+        for k in keys:
+            h = led.file(
+                "placement", k, "alloc", "tcp://led:0", "smk",
+                0.001, 0.002, True, 1024, 1, 0.01, "tcp://led:1", "",
+            )
+            led.join_row(h, "memory", "tcp://led:0", None, 0.005, None)
+
+    for _ in range(wraps):
+        cycle()
+    # the A/B floods above leave reference cycles whose lazy collection
+    # would otherwise land inside the measured window; collect, then
+    # re-warm so the window starts from a settled allocator
+    gc.collect()
+    for _ in range(32):
+        cycle()
+    b0 = _sys.getallocatedblocks()
+    for _ in range(20_000 // len(keys)):
+        cycle()
+    alloc_delta = _sys.getallocatedblocks() - b0
+    assert alloc_delta < 50, (
+        f"ledger file+join allocated ({alloc_delta} blocks over 20k "
+        "decision cycles)"
+    )
+
+    # regret artifact + critical-path gate on the deterministic sim:
+    # telemetry-seeded non-uniform fleet — the measured shadow must
+    # out-predict the constants, and attribution must sum to the
+    # virtual makespan within 1%
+    from distributed_tpu.diagnostics.critical_path import check
+    from distributed_tpu.sim import ClusterSim, SyntheticDag
+    from distributed_tpu.sim.links import LinkProfile
+
+    links = LinkProfile(bandwidth=2e7, jitter=0.9, seed=7)
+    sim = ClusterSim(
+        12, nthreads=2, seed=7, links=links, validate=True,
+        ledger_size=65536,
+    )
+    rows = []
+    addrs = list(sim.workers)
+    for src in addrs:
+        for dst in addrs:
+            if src == dst:
+                continue
+            bw, lat = links._edge(src, dst)
+            nb = 10_000_000
+            rows.append([src, dst, nb, nb / bw + lat, 4])
+    sim.state.telemetry.fold_rows(rows, reporter="")
+    SyntheticDag(
+        n_layers=6, layer_width=18, fanin=2, seed=7, layers_per_chunk=3,
+        duration_range=(0.001, 0.005), nbytes_range=(256_000, 2_000_000),
+    ).start(sim)
+    rep = sim.run()
+    lsum = rep["ledger"]
+    assert lsum["unjoined"] == 0 and lsum["open"] == 0, lsum
+    reg = lsum["regret_abs_mean"]
+    assert reg["measured"] < reg["constant"], (
+        "measured-shadow aggregate regret did not beat the constants "
+        f"on the telemetry-seeded non-uniform fleet: {reg}"
+    )
+    cp = sim.critical_path()
+    assert cp is not None
+    check(cp, tolerance=0.01)
+    assert abs(cp["makespan"] - rep["virtual_makespan_s"]) <= (
+        0.01 * rep["virtual_makespan_s"]
+    ), (cp["makespan"], rep["virtual_makespan_s"])
+
+    out = asyncio.run(_smoke_ledger_live())
+    out.update({
+        "n_workers": N_WORKERS,
+        "n_tasks": N_TASKS,
+        "ledger_on_s": [round(w, 3) for w in on_walls],
+        "ledger_off_s": [round(w, 3) for w in off_walls],
+        "overhead_pct": round(overhead_pct, 2),
+        "alloc_delta_blocks": alloc_delta,
+        "regret_abs_constant": round(reg["constant"], 6),
+        "regret_abs_measured": round(reg["measured"], 6),
+        "measured_beats_constant": True,
+        "cp_makespan_s": round(cp["makespan"], 6),
+        "cp_check_ok": True,
+        "sim_joined": lsum["joined"],
+        "host_canary_ms": _host_canary_ms(),
+    })
+    return out
+
+
 def run_smoke():
     """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
     line on stdout; raises (non-zero exit) on any failure."""
@@ -1970,6 +2193,7 @@ def run_smoke():
         "trace": retry_once(_smoke_trace),
         "telemetry": retry_once(_smoke_telemetry),
         "selfprofile": retry_once(_smoke_selfprofile),
+        "ledger": retry_once(_smoke_ledger),
         "sim": _smoke_sim(),
         # LAST on purpose: the sharded programs spin up the 8-device
         # XLA runtime (one thread pool per virtual device on a 2-core

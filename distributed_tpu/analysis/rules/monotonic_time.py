@@ -61,6 +61,10 @@ class MonotonicTimeRule(Rule):
         # monotonic clock — an NTP step must never skew a bandwidth
         # sample or misalign /telemetry records against /trace
         "distributed_tpu/telemetry.py",
+        # the decision ledger's regrets are differences of two stamps
+        # on one clock — a wall step between decision and join would
+        # fabricate regret out of thin air
+        "distributed_tpu/ledger.py",
         # the simulator must never read ANY real clock (virtual time is
         # the determinism contract); the rule bans the wall-clock half,
         # and the sim's own code reads only its VirtualClock

@@ -171,6 +171,14 @@ defaults: dict[str, Any] = {
             # sampled evals
             "divergence-sample": 1,
         },
+        # decision–outcome ledger (ledger.py; docs/observability.md
+        # "Decision ledger & critical-path"): every placement/steal/AMM
+        # decision files a bounded row joined to its realized outcome —
+        # the regret signal ROADMAP item 1's payoff gates calibrate on.
+        "ledger": {
+            "enabled": True,
+            "size": 16384,   # rows resident (rounded up to a power of two)
+        },
         "active-memory-manager": {
             "start": True,
             "interval": "2s",

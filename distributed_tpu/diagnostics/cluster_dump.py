@@ -47,6 +47,14 @@ class DumpArtefact:
         # control-plane self-profile tail (diagnostics/selfprofile.py):
         # wall budget, sampled loop/planner tree, stall captures
         self.profile: dict = dict(sched.get("profile") or {})
+        # decision–outcome ledger tail + precomputed critical-path
+        # summary (ledger.py, diagnostics/critical_path.py)
+        led = sched.get("ledger") or {}
+        self.ledger: list = list(led.get("rows") or [])
+        self.ledger_summary: dict = dict(led.get("summary") or {})
+        self._critical_path_precomputed: dict | None = (
+            dict(led["critical_path"]) if led.get("critical_path") else None
+        )
 
     @classmethod
     def from_file(cls, path: str) -> "DumpArtefact":
@@ -142,6 +150,36 @@ class DumpArtefact:
             if type_ is None or rec.get("type") == type_
         ]
 
+    def ledger_rows(self, *, kind: str | None = None,
+                    outcome: str | None = None) -> list[dict]:
+        """Decision–outcome rows from the dump, filtered by decision
+        kind and/or outcome — the post-mortem twin of the live
+        ``/ledger`` route (ledger.py): e.g. every steal whose realized
+        cost overshot its prediction at the moment of the dump."""
+        return [
+            row for row in self.ledger
+            if (kind is None or row.get("kind") == kind)
+            and (outcome is None or row.get("outcome") == outcome)
+        ]
+
+    def critical_path(self, full: bool = False) -> dict | None:
+        """Critical-path attribution for the dumped run: the summary
+        the scheduler precomputed at dump time, or — with
+        ``full=True`` (or when the dump predates the precompute) — a
+        fresh walk over the dump's own ledger rows and task
+        dependency map (diagnostics/critical_path.py)."""
+        if not full and self._critical_path_precomputed is not None:
+            return self._critical_path_precomputed
+        from distributed_tpu.diagnostics.critical_path import (
+            critical_path,
+        )
+
+        deps = {
+            k: list(t.get("dependencies") or ())
+            for k, t in self.tasks.items()
+        }
+        return critical_path(self.ledger, deps)
+
     def workers_summary(self) -> dict[str, dict]:
         return {
             addr: {
@@ -164,5 +202,6 @@ class DumpArtefact:
             f"<DumpArtefact tasks={len(self.tasks)} "
             f"workers={len(self.workers)} "
             f"log={len(self.transition_log)} rows "
-            f"trace={len(self.flight_recorder)} events>"
+            f"trace={len(self.flight_recorder)} events "
+            f"ledger={len(self.ledger)} rows>"
         )

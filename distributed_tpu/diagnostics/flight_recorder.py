@@ -63,10 +63,13 @@ _TRACKS = {
     "stall": (8, "loop stalls (watchdog captures)"),
 }
 _OTHER_TRACK = (9, "other")
+_LEDGER_TRACK = (10, "ledger (decision joins)")
+_CP_TRACK = (11, "critical path")
 
 
 def to_perfetto(events: Iterable[dict],
-                telemetry: Iterable[dict] | None = None) -> dict:
+                telemetry: Iterable[dict] | None = None,
+                ledger: Iterable[dict] | None = None) -> dict:
     """Chrome ``trace_event`` JSON (the "JSON Array Format" with
     metadata) from flight-recorder events.  Timestamps are the ring's
     monotonic seconds scaled to microseconds — absolute values are
@@ -98,7 +101,9 @@ def to_perfetto(events: Iterable[dict],
             "tid": tid,
             "args": {"name": label},
         }
-        for tid, label in (*_TRACKS.values(), _OTHER_TRACK)
+        for tid, label in (
+            *_TRACKS.values(), _OTHER_TRACK, _LEDGER_TRACK, _CP_TRACK,
+        )
     ]
     for ev in events:
         cat = ev.get("cat", "")
@@ -177,6 +182,81 @@ def to_perfetto(events: Iterable[dict],
                     "pid": 0,
                     "tid": 0,
                     "args": {"ms": float(rec.get("rtt", 0.0)) * 1e3},
+                }
+            )
+    for rec in ledger or ():
+        kind = rec.get("type")
+        if kind == "ledger-row":
+            if not rec.get("outcome"):
+                continue  # still-open rows have no join timestamp
+            ts = float(rec.get("t_join", 0.0)) * 1e6
+            trace_events.append(
+                {
+                    "name": (
+                        f"{rec.get('kind', '?')}:"
+                        f"{rec.get('outcome', '?')}"
+                    ),
+                    "cat": "ledger",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": ts,
+                    "pid": 0,
+                    "tid": _LEDGER_TRACK[0],
+                    # stim joins the row to the decision's ingress/
+                    # engine/kernel swimlanes; plan_stim to the landed
+                    # plan's kernel event
+                    "args": {
+                        "key": rec.get("key", ""),
+                        "stim": rec.get("stim", ""),
+                        "plan_stim": rec.get("plan_stim", ""),
+                        "worker": rec.get("worker", ""),
+                        "regret_constant": rec.get("regret_constant"),
+                        "regret_measured": rec.get("regret_measured"),
+                    },
+                }
+            )
+            if rec.get("outcome") in ("memory", "replicated"):
+                # the regret counter track: per-join samples of both
+                # models' realized-minus-predicted seconds
+                trace_events.append(
+                    {
+                        "name": "ledger regret seconds",
+                        "ph": "C",
+                        "ts": ts,
+                        "pid": 0,
+                        "tid": 0,
+                        "args": {
+                            "constant": float(
+                                rec.get("regret_constant") or 0.0
+                            ),
+                            "measured": float(
+                                rec.get("regret_measured") or 0.0
+                            ),
+                        },
+                    }
+                )
+        elif kind == "cp-segment":
+            # the critical path as complete-duration events: one named
+            # slice per phase segment, joined to the swimlanes by stim
+            t0 = float(rec.get("t0", 0.0))
+            t1 = float(rec.get("t1", t0))
+            trace_events.append(
+                {
+                    "name": f"{rec.get('phase', '?')} "
+                            f"{rec.get('key', '')}",
+                    "cat": "critical-path",
+                    "ph": "X",
+                    "ts": t0 * 1e6,
+                    "dur": max(t1 - t0, 0.0) * 1e6,
+                    "pid": 0,
+                    "tid": _CP_TRACK[0],
+                    "args": {
+                        "key": rec.get("key", ""),
+                        "prefix": rec.get("prefix", ""),
+                        "stim": rec.get("stim", ""),
+                        "plan_stim": rec.get("plan_stim", ""),
+                        "worker": rec.get("worker", ""),
+                    },
                 }
             )
     return {
@@ -373,6 +453,24 @@ def _fetch_url(url: str) -> str:
         return r.read().decode()
 
 
+def load_json(path: str) -> Any:
+    """Read one JSON document from disk (cluster dumps, deps maps).
+    Lives here rather than in the analyzers because this module owns
+    the offline file IO — ``diagnostics/critical_path.py`` is in the
+    sans-io lint scope and delegates all reads/writes."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_jsonl_source(src: str) -> list[dict]:
+    """JSONL records from a file path or an http(s) URL (the /trace,
+    /telemetry and /ledger routes all serve this shape)."""
+    if src.startswith(("http://", "https://")):
+        return from_jsonl(_fetch_url(src))
+    with open(src) as f:
+        return from_jsonl(f.read())
+
+
 def summarize(events: list[dict]) -> str:
     by_cat: dict[str, int] = {}
     stims: set[str] = set()
@@ -417,6 +515,14 @@ def main(argv: list[str] | None = None) -> int:
              "stimulus timeline",
     )
     parser.add_argument(
+        "--ledger", metavar="SRC",
+        help="also render decision-ledger JSONL (file path or http "
+             "URL: the /ledger route, a dumped tail, or critical-path "
+             "records from diagnostics.critical_path --out) as a "
+             "ledger-joins track, a regret counter track, and a named "
+             "critical-path track joined to the stimulus swimlanes",
+    )
+    parser.add_argument(
         "--jsonl", metavar="OUT",
         help="re-emit the (possibly url-fetched) events as JSONL to OUT",
     )
@@ -442,11 +548,10 @@ def main(argv: list[str] | None = None) -> int:
     events = from_jsonl(text)
     telemetry = None
     if args.telemetry:
-        if args.telemetry.startswith(("http://", "https://")):
-            telemetry = from_jsonl(_fetch_url(args.telemetry))
-        else:
-            with open(args.telemetry) as f:
-                telemetry = from_jsonl(f.read())
+        telemetry = _read_jsonl_source(args.telemetry)
+    ledger = None
+    if args.ledger:
+        ledger = _read_jsonl_source(args.ledger)
 
     wrote = False
     if args.speedscope:
@@ -476,7 +581,10 @@ def main(argv: list[str] | None = None) -> int:
         wrote = True
     if args.perfetto:
         with open(args.perfetto, "w") as f:
-            json.dump(to_perfetto(events, telemetry=telemetry), f)
+            json.dump(
+                to_perfetto(events, telemetry=telemetry, ledger=ledger),
+                f,
+            )
         print(f"wrote {len(events)} events to {args.perfetto}")
         wrote = True
     if args.jsonl:

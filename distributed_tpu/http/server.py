@@ -147,23 +147,33 @@ def prom_line(name: str, value: float, labels: dict | None = None,
 
 
 def prom_histogram_lines(name: str, hist: Any,
-                         help_: str | None = None) -> list[str]:
+                         help_: str | None = None,
+                         labels: dict | None = None) -> list[str]:
     """Prometheus histogram exposition for a ``tracing.Histogram``:
     cumulative ``le`` buckets + ``_sum`` + ``_count``, p50/p99-capable
-    via ``histogram_quantile`` in any Prometheus UI."""
+    via ``histogram_quantile`` in any Prometheus UI.  ``labels`` (e.g.
+    the ledger's ``kind``/``model``) merge into every sample; emit the
+    HELP/TYPE header on the first labeled family only."""
     lines = []
     if help_:
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} histogram")
+    lab = (
+        "".join(f'{k}="{v}",' for k, v in labels.items()) if labels else ""
+    )
     cum = 0
     for bound, count in zip(hist.bounds, hist.counts):
         cum += count
         le = repr(float(bound)) if bound != int(bound) else str(int(bound))
-        lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{name}_bucket{{{lab}le="{le}"}} {cum}')
     cum += hist.counts[-1]
-    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-    lines.append(f"{name}_sum {hist.sum}")
-    lines.append(f"{name}_count {hist.count}")
+    lines.append(f'{name}_bucket{{{lab}le="+Inf"}} {cum}')
+    if lab:
+        lines.append(f"{name}_sum{{{lab[:-1]}}} {hist.sum}")
+        lines.append(f"{name}_count{{{lab[:-1]}}} {hist.count}")
+    else:
+        lines.append(f"{name}_sum {hist.sum}")
+        lines.append(f"{name}_count {hist.count}")
     return lines
 
 
@@ -257,6 +267,188 @@ def selfprofile_metric_lines(wall: Any, profiler: Any = None,
                 type_="counter",
             )
         )
+    return lines
+
+
+#: computed once per process: the constant identity labels never change
+_BUILD_INFO_CACHE: dict[str, str] = {}
+
+
+def build_info_lines(role: str) -> list[str]:
+    """``dtpu_build_info`` — the standard always-1 identity gauge: which
+    build/runtime is behind this /metrics endpoint (version, jax
+    version, backend, engine-mesh layout).  Label values are computed
+    once per process; a jax import failure degrades to empty labels
+    rather than breaking the scrape."""
+    cached = _BUILD_INFO_CACHE.get(role)
+    if cached is None:
+        from distributed_tpu import config
+
+        version = jax_version = backend = ""
+        try:
+            from distributed_tpu import __version__
+
+            version = str(__version__)
+        # graft-lint: allow[swallowed-exceptions] identity labels degrade to ""
+        except Exception:
+            pass
+        try:
+            import jax
+
+            jax_version = str(jax.__version__)
+            backend = str(jax.default_backend())
+        # graft-lint: allow[swallowed-exceptions] identity labels degrade to ""
+        except Exception:
+            pass
+        mesh = (
+            f"{config.get('scheduler.jax.mesh.enabled')}"
+            f"/{config.get('scheduler.jax.mesh.layout')}"
+        )
+        cached = _BUILD_INFO_CACHE[role] = prom_line(
+            "dtpu_build_info", 1,
+            {
+                "role": role,
+                "version": version,
+                "jax": jax_version,
+                "backend": backend,
+                "mesh": mesh,
+            },
+            help_="Build/runtime identity (always 1; labels carry the "
+                  "version, jax version, backend and engine-mesh layout)",
+            type_="gauge",
+        )
+    return [cached]
+
+
+#: exposition cap on ledger per-link label pairs (same rationale as
+#: TELEMETRY_MAX_LINKS below) and per-prefix rows
+LEDGER_MAX_LABELS = 64
+
+
+def ledger_metric_lines(ledger: Any) -> list[str]:
+    """``dtpu_ledger_*`` exposition (ledger.py; docs/observability.md
+    "Decision ledger & critical-path"): join health counters, the
+    per-kind regret histograms for BOTH cost models, and bounded
+    per-prefix / per-link regret aggregates — the live answer to "how
+    wrong were the decisions we just made"."""
+    import heapq
+
+    lines = [
+        prom_line(
+            "dtpu_ledger_rows_total", ledger.filed_total,
+            help_="Decision rows filed (placements, steals, AMM "
+                  "replica decisions)",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_ledger_joined_total", ledger.joined_total,
+            help_="Decision rows joined to a realized outcome",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_ledger_unjoined_total", ledger.unjoined_total,
+            help_="Open rows aged out of the ring before their outcome "
+                  "arrived (ring too small or outcomes never reported)",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_ledger_superseded_total", ledger.superseded_total,
+            help_="Rows replaced by a newer decision for the same key "
+                  "before reality tested them (steal churn)",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_ledger_open_rows", ledger.open_rows,
+            help_="Decisions currently awaiting their outcome",
+            type_="gauge",
+        ),
+    ]
+    first = True
+    for (kind, model), hist in sorted(ledger.hists.items()):
+        lines.extend(
+            prom_histogram_lines(
+                "dtpu_ledger_regret_seconds", hist,
+                help_="Per-decision regret: realized non-compute "
+                      "seconds minus the model's predicted comm cost "
+                      "(signed; labels kind + cost model)"
+                if first else None,
+                labels={"kind": kind, "model": model},
+            )
+        )
+        first = False
+    top_prefixes = heapq.nlargest(
+        LEDGER_MAX_LABELS, ledger.prefix_agg.items(),
+        key=lambda kv: kv[1][0],
+    )
+    first = True
+    for prefix, (n, abs_c, abs_m) in top_prefixes:
+        for model, v in (("constant", abs_c), ("measured", abs_m)):
+            lines.append(
+                prom_line(
+                    "dtpu_ledger_prefix_regret_seconds_total", v,
+                    {"prefix": prefix, "model": model},
+                    help_="Absolute regret accumulated per task prefix "
+                          "and cost model"
+                    if first else None,
+                    type_="counter",
+                )
+            )
+            first = False
+    first = True
+    for prefix, (n, *_rest) in top_prefixes:
+        lines.append(
+            prom_line(
+                "dtpu_ledger_prefix_decisions_total", n,
+                {"prefix": prefix},
+                help_="Regret-observed decisions per task prefix"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    top_links = heapq.nlargest(
+        LEDGER_MAX_LABELS, ledger.link_agg.items(),
+        key=lambda kv: kv[1][0],
+    )
+    first = True
+    for (src, dst), (n, transfer_s, abs_c, abs_m) in top_links:
+        for model, v in (("constant", abs_c), ("measured", abs_m)):
+            lines.append(
+                prom_line(
+                    "dtpu_ledger_link_regret_seconds_total", v,
+                    {"src": src, "dst": dst, "model": model},
+                    help_="Absolute regret accumulated per dominant "
+                          "dep link and cost model"
+                    if first else None,
+                    type_="counter",
+                )
+            )
+            first = False
+    first = True
+    for (src, dst), (n, transfer_s, _ac, _am) in top_links:
+        lines.append(
+            prom_line(
+                "dtpu_ledger_link_transfer_seconds_total", transfer_s,
+                {"src": src, "dst": dst},
+                help_="Realized transfer seconds attributed per "
+                      "dominant dep link (telemetry-priced at join)"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
+    first = True
+    for (src, dst), (n, *_rest) in top_links:
+        lines.append(
+            prom_line(
+                "dtpu_ledger_link_decisions_total", n,
+                {"src": src, "dst": dst},
+                help_="Regret-observed decisions per dominant dep link"
+                if first else None,
+                type_="counter",
+            )
+        )
+        first = False
     return lines
 
 
@@ -451,7 +643,7 @@ def scheduler_metrics(scheduler: Any) -> bytes:
     """Prometheus exposition for the scheduler
     (reference http/scheduler/prometheus/core.py)."""
     s = scheduler.state
-    lines = []
+    lines = build_info_lines("scheduler")
     by_state: dict[str, int] = {}
     for ts in s.tasks.values():
         by_state[ts.state] = by_state.get(ts.state, 0) + 1
@@ -572,6 +764,7 @@ def scheduler_metrics(scheduler: Any) -> bytes:
     ):
         lines.extend(prom_histogram_lines(name, hist, help_=help_))
     lines.extend(cluster_telemetry_metric_lines(s.telemetry))
+    lines.extend(ledger_metric_lines(s.ledger))
     lines.extend(trace_metric_lines(s.trace))
     lines.extend(
         selfprofile_metric_lines(
@@ -587,7 +780,8 @@ def scheduler_metrics(scheduler: Any) -> bytes:
 def worker_metrics(worker: Any) -> bytes:
     """Prometheus exposition for a worker (reference http/worker/prometheus/)."""
     st = worker.state
-    lines = [
+    lines = build_info_lines("worker")
+    lines += [
         prom_line("dtpu_worker_tasks_executing", len(st.executing),
                   help_="Currently executing", type_="gauge"),
         prom_line("dtpu_worker_tasks_ready", len(st.ready)),

@@ -130,13 +130,43 @@ class ActiveMemoryManagerExtension:
                     logger.exception("AMM policy %r failed", policy)
             drop_by_worker: defaultdict = defaultdict(list)
             repl_by_worker: defaultdict = defaultdict(dict)
+            state = self.state
+            ledger = state.ledger
             for ts, (recipients, droppers) in self.pending.items():
                 if recipients:
                     holders = [wss.address for wss in ts.who_has]
                     for ws in recipients:
                         repl_by_worker[ws.address][ts.key] = holders
+                        if ledger.enabled:
+                            # decision ledger (ledger.py): one amm-repl
+                            # row per (key, recipient), joined when the
+                            # replica's add-keys lands — regret audits
+                            # the predicted transfer price vs realized
+                            # acquire latency
+                            nb = ts.get_nbytes()
+                            measured, used = (
+                                state.get_replica_cost_measured(ts, ws)
+                            )
+                            ledger.file_amm(
+                                "amm-repl", ts.key, ws.address,
+                                stimulus_id,
+                                pred_constant=(
+                                    nb / state.bandwidth
+                                    + state.transfer_latency
+                                ),
+                                pred_measured=measured,
+                                used_measured=used, nbytes=nb,
+                                src=holders[0] if holders else "",
+                            )
                 for ws in droppers:
                     drop_by_worker[ws.address].append(ts.key)
+                    if ledger.enabled:
+                        # drops predict no transfer; the row audits the
+                        # decision->release-worker-data latency only
+                        ledger.file_amm(
+                            "amm-drop", ts.key, ws.address, stimulus_id,
+                            nbytes=ts.get_nbytes(),
+                        )
             worker_msgs: dict = {}
             for addr, who_has in repl_by_worker.items():
                 worker_msgs.setdefault(addr, []).append({

@@ -201,6 +201,12 @@ class JaxPlacement:
         self.mesh_layout = str(config.get("scheduler.jax.mesh.layout"))
         self._mesh: Any = _MESH_UNSET
         self.plan: dict[Key, str] = {}
+        # stimulus id of the most recently LANDED plan: the decision
+        # ledger stamps it onto every plan-homed placement row
+        # (ledger.py ``plan_stim`` field), joining "this task ran on its
+        # plan home" back to the flight recorder's ``kernel``
+        # placement-plan event that computed the assignment
+        self.plan_stim: str = ""
         self.plans_computed = 0
         self.plan_hits = 0
         self.plan_misses = 0
@@ -383,7 +389,11 @@ class JaxPlacement:
         if len(ws.processing) < depth:
             del self.plan[ts.key]
             self.plan_hits += 1
-            ts.homed = follow_key is None
+            # "plan" provenance: truthy for the steal exemption, and
+            # the decision ledger labels the placement row kind "plan"
+            # (the shuffle extension pins with "pin" — same exemption,
+            # different ledger attribution)
+            ts.homed = "plan" if follow_key is None else False
             return "hit", ws
         self.plan_parks += 1
         return "park", ws
@@ -552,6 +562,7 @@ class JaxPlacement:
             if engine_shards:
                 state.observe_engine_shards(engine_shards)
             self.plan.update(plan)
+            self.plan_stim = stimulus_id
             self.plans_computed += 1
             return len(plan)
 
@@ -594,7 +605,9 @@ class JaxPlacement:
                     )
                     self.enabled = False
             try:
-                loop.call_soon_threadsafe(self._merge, plan, state)
+                loop.call_soon_threadsafe(
+                    self._merge, plan, state, stimulus_id
+                )
             except RuntimeError:
                 # loop closed before the plan landed: the merge (and its
                 # inflight decrement) will never run on-loop
@@ -618,7 +631,8 @@ class JaxPlacement:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
 
-    def _merge(self, plan_shards, state: "SchedulerState") -> None:
+    def _merge(self, plan_shards, state: "SchedulerState",
+               stimulus_id: str = "") -> None:
         """Land an async plan on the loop thread, keeping only hints for
         tasks still pending — tasks the oracle placed while the plan was
         computing would otherwise accumulate as dead entries forever
@@ -637,6 +651,7 @@ class JaxPlacement:
             self.hint_drops["landed-late"] += len(plan) - len(live)
             if live:
                 self.plan.update(live)
+                self.plan_stim = stimulus_id
                 self.plans_computed += 1
                 logger.debug(
                     "planned %d tasks on device (%d already placed)",

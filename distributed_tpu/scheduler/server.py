@@ -144,6 +144,7 @@ class Scheduler(Server):
             "unregister_worker_plugin": self.unregister_worker_plugin,
             "get_cluster_state": self.get_cluster_state,
             "get_telemetry": self.get_telemetry,
+            "get_ledger": self.get_ledger,
             "get_runspec": self.get_runspec,
             "versions": self.versions,
             "worker_versions": self.worker_versions,
@@ -321,8 +322,7 @@ class Scheduler(Server):
 
             from distributed_tpu.tracing import to_jsonl
 
-            self.http_server = HTTPServer(
-                {
+            routes: dict = {
                     "/health": lambda: "ok",
                     "/info": self.identity,
                     "/metrics": lambda: scheduler_metrics(self),
@@ -351,10 +351,24 @@ class Scheduler(Server):
                         ),
                         "application/x-ndjson",
                     ),
+                    # decision–outcome ledger: summary head + resident
+                    # row tail as JSONL (ledger.py;
+                    # docs/observability.md "Decision ledger")
+                    "/ledger": lambda: (
+                        to_jsonl(self.state.ledger.snapshot()),
+                        "application/x-ndjson",
+                    ),
                     **json_api_routes(self),
-                },
-                port=self._http_port,
-            )
+            }
+            # route index at "/": observability discoverability — one
+            # GET lists every route this role serves (/metrics, /trace,
+            # /telemetry, /profile, /ledger, ...)
+            routes["/"] = lambda: {
+                "role": "scheduler",
+                "id": self.id,
+                "routes": sorted(r for r in routes if r != "/"),
+            }
+            self.http_server = HTTPServer(routes, port=self._http_port)
             await self.http_server.start()
         if self.worker_ttl:
             self.periodic_callbacks["worker-ttl"] = PeriodicCallback(
@@ -1798,6 +1812,12 @@ class Scheduler(Server):
         twin of the HTTP ``/telemetry`` route (telemetry.py)."""
         return self.state.telemetry.snapshot()
 
+    async def get_ledger(self, n: int | None = None) -> list[dict]:
+        """The decision–outcome ledger (summary head + resident row
+        tail): the RPC twin of the HTTP ``/ledger`` route (ledger.py;
+        docs/observability.md "Decision ledger & critical-path")."""
+        return self.state.ledger.snapshot(n)
+
     async def get_cluster_state(self, exclude: list[str] | None = None) -> dict:
         """Debug dump of the whole cluster (reference scheduler.py:3964)."""
         s = self.state
@@ -1838,6 +1858,40 @@ class Scheduler(Server):
             # post-mortem can see which links/priors the cost model was
             # lying about without a live cluster (telemetry.py)
             scheduler_info["telemetry"] = self.state.telemetry.snapshot()
+        if "ledger" not in (exclude or ()):
+            # decision–outcome ledger tail + a PRECOMPUTED critical-path
+            # summary (ledger.py, diagnostics/critical_path.py): the
+            # dump's task table still holds the dependency map here, so
+            # the path is computed while the graph is known — the
+            # offline DumpArtefact.critical_path() recomputes it from
+            # the same two sections
+            ledger_info: dict[str, Any] = {
+                "rows": s.ledger.tail(500),
+                "summary": s.ledger.summary(),
+            }
+            try:
+                from distributed_tpu.diagnostics.critical_path import (
+                    critical_path,
+                )
+
+                cp = critical_path(
+                    ledger_info["rows"],
+                    {
+                        k: [d.key for d in ts.dependencies]
+                        for k, ts in s.tasks.items()
+                    },
+                )
+                if cp is not None:
+                    ledger_info["critical_path"] = {
+                        "makespan": cp["makespan"],
+                        "n_tasks": cp["n_tasks"],
+                        "terminal": cp["terminal"],
+                        "attribution": cp["attribution"],
+                        "by_prefix": cp["by_prefix"],
+                    }
+            except Exception:
+                logger.exception("critical-path precompute failed")
+            scheduler_info["ledger"] = ledger_info
         if "transition_log" not in (exclude or ()):
             # the newest transition rows travel WITH the dump so a
             # post-mortem can replay a task's story offline

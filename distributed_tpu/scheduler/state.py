@@ -44,6 +44,7 @@ from distributed_tpu.exceptions import (
 )
 from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.graph.spec import TaskSpec
+from distributed_tpu.ledger import DecisionLedger
 from distributed_tpu.protocol.serialize import compact_frames, wrap_opaque
 from distributed_tpu.telemetry import ClusterTelemetry
 from distributed_tpu.tracing import (
@@ -240,6 +241,7 @@ class TaskState:
         "run_id",
         "queueable",
         "homed",
+        "ledger_row",
         "_rootish",
         "_hash",
     )
@@ -280,8 +282,16 @@ class TaskState:
         self.queueable = True
         # placed on its plan-assigned home worker: exempt from stealing
         # (the balancer scattering a co-assigned tile undoes the plan's
-        # whole point); cleared on processing exit and on home pause
-        self.homed = False
+        # whole point); cleared on processing exit and on home pause.
+        # Truthy values carry provenance for the decision ledger:
+        # "plan" = jax_placement plan home, "pin" = shuffle pin (same
+        # steal exemption, different ledger attribution)
+        self.homed: bool | str = False
+        # open decision-ledger row handle (ledger.py): -1 = none.  The
+        # handle lives on the task instead of a key-indexed dict so the
+        # file/join hot path pays no string hash; stale handles are
+        # validity-checked by the ledger.
+        self.ledger_row = -1
         self._rootish: bool | None = None
 
     def __repr__(self) -> str:
@@ -479,6 +489,15 @@ class SchedulerState:
         # kernel inputs in a future PR.
         self.telemetry = ClusterTelemetry()
         self.telemetry.clock = self.clock
+        # decision–outcome ledger (ledger.py; docs/observability.md
+        # "Decision ledger & critical-path"): every placement / steal /
+        # AMM replica decision files a bounded preallocated row carrying
+        # the prediction (constants AND PR 7's measured shadow); the
+        # realized outcome joins it at memory/erred/confirm and emits
+        # per-decision regret.  Runs on the same injectable clock, so
+        # the simulator's joins are exact and deterministic.
+        self.ledger = DecisionLedger()
+        self.ledger.clock = self.clock
         self.tasks: dict[Key, TaskState] = {}
         self.task_groups: dict[str, TaskGroup] = {}
         # one entry per update_graph batch (reference scheduler.py:864)
@@ -652,6 +671,9 @@ class SchedulerState:
             ws.used_resources = dict.fromkeys(ws.used_resources, 0)
             self.check_idle_saturated(ws)
         self._total_occupancy = 0.0
+        # open decision rows reference the cleared tasks: close them so
+        # they don't age out as false unjoineds after a restart
+        self.ledger.resolve_all("released", now=self.clock())
 
     # ------------------------------------------------- transition engine
 
@@ -1105,6 +1127,12 @@ class SchedulerState:
                     "stimulus_id": stimulus_id,
                 }
             ]
+        if ts.ledger_row >= 0:
+            # the placement was cancelled mid-flight: no regret to
+            # observe, but the row must close (else it ages out as a
+            # false unjoined)
+            self.ledger.join_row(ts.ledger_row, "released")
+            ts.ledger_row = -1
         self._exit_processing_common(ts)
         ts.state = "released"
         self._count_transition(ts, "processing", "released")
@@ -1133,10 +1161,12 @@ class SchedulerState:
         wws = ws
 
         # update duration statistics (reference scheduler.py:2366 + _observe)
+        realized_compute = 0.0
         if startstops:
             for startstop in startstops:
                 if startstop.get("action") == "compute":
                     duration = startstop["stop"] - startstop["start"]
+                    realized_compute += duration
                     ts.prefix.add_duration(duration)
                     # the prefix now HAS a measured duration: release
                     # the tasks parked under it at placement time
@@ -1153,6 +1183,18 @@ class SchedulerState:
                         ts.group.start = startstop["start"]
                     ts.group.stop = max(ts.group.stop, startstop["stop"])
 
+        row = ts.ledger_row
+        if row >= 0:
+            # decision–outcome join (ledger.py): realized compute is the
+            # worker-reported duration (clock-agnostic); the join stamp
+            # and the decision stamp share THIS engine's clock, so
+            # realized total — and therefore regret — is exact under
+            # the simulator's virtual time
+            ts.ledger_row = -1
+            self.ledger.join_row(
+                row, "memory", worker, self.clock(),
+                realized_compute, self.telemetry,
+            )
         self._exit_processing_common(ts)
         if nbytes is not None:
             self.update_nbytes(ts, nbytes)
@@ -1184,6 +1226,11 @@ class SchedulerState:
         ts = self.tasks[key]
         failing_ws = ts.processing_on
         if failing_ws is not None:
+            if ts.ledger_row >= 0:
+                self.ledger.join_row(
+                    ts.ledger_row, "erred", worker or "", self.clock(),
+                )
+                ts.ledger_row = -1
             self._exit_processing_common(ts)
         if self.validate:
             assert cause or ts.exception_blame
@@ -1575,8 +1622,16 @@ class SchedulerState:
                     ws.used_resources[r] -= quantity
         self.check_idle_saturated(ws)
 
-    def _add_to_processing(self, ts: TaskState, ws: WorkerState, stimulus_id: str) -> dict:
-        """Assign ts to ws (reference scheduler.py:3199)."""
+    def _add_to_processing(
+        self, ts: TaskState, ws: WorkerState, stimulus_id: str,
+        kind: str | None = None,
+    ) -> dict:
+        """Assign ts to ws (reference scheduler.py:3199).
+
+        ``kind`` labels the decision in the ledger (``steal`` /
+        ``steal-spec`` from the stealing extension); ``None`` derives
+        ``plan`` for jax_placement plan homes and ``placement``
+        otherwise."""
         if self.validate:
             assert not ts.waiting_on
             assert not ts.who_has
@@ -1588,6 +1643,25 @@ class SchedulerState:
         # shadow divergence monitor (read-only): this is THE placement
         # decision — record what the measured model would have priced
         self.shadow_comm_cost(ts, ws, comm, "placement", stimulus_id)
+        led = self.ledger
+        if led.enabled:
+            if ts.dependencies or (kind is None and ts.homed):
+                # dep-bearing (link pricing) or homed (plan/pin kind
+                # derivation incl. plan_stim): the full filing helper
+                self.ledger_file_decision(ts, ws, stimulus_id, kind,
+                                          duration, comm)
+            else:
+                # dep-free fast path, inlined: no links to price, both
+                # models predict 0 transfer — the row carries identity
+                # + the duration prediction only
+                prefix = ts.prefix
+                ts.ledger_row = led.file(
+                    kind if kind is not None else "placement", ts.key,
+                    prefix.name if prefix is not None else "",
+                    ws.address, stimulus_id, comm, comm, False,
+                    0, 0, duration, "", "",
+                    supersede=ts.ledger_row,
+                )
         ws.processing[ts] = duration + comm
         ts.processing_on = ws
         ts.state = "processing"
@@ -1730,6 +1804,84 @@ class SchedulerState:
             "shadow", site, stimulus_id, key=ts.key,
             n=int(ratio * 1000), dest=ws.address,
         )
+
+    # --------------------------------------------- decision ledger filing
+
+    def ledger_file_decision(self, ts: TaskState, ws: WorkerState,
+                             stimulus_id: str, kind: str | None,
+                             duration: float, comm: float) -> None:
+        """File one task-cost decision row (ledger.py): the prediction
+        half — constant comm cost, the measured shadow's price, the
+        missing-dep byte total, and the dominant dep link (best holder
+        of the heaviest missing dep).  The realized half joins when the
+        task reaches memory/erred (docs/observability.md)."""
+        dep_bytes = 0
+        n_deps = 0
+        src = ""
+        measured, used = comm, False
+        if ts.dependencies:
+            heaviest = -1
+            for dts in ts.dependencies:
+                if ws in dts.who_has:
+                    continue
+                nb = dts.get_nbytes()
+                dep_bytes += nb
+                n_deps += 1
+                if nb > heaviest:
+                    heaviest = nb
+                    for hws in dts.who_has:
+                        src = hws.address
+                        break
+            if n_deps:
+                tel = self.telemetry
+                if tel.enabled and (tel.links or tel.rtt):
+                    measured, used = self.get_comm_cost_measured(ts, ws)
+                # else: nothing measured yet — the measured model falls
+                # back to the constants dep-for-dep, so its price IS
+                # ``comm``; skip the recompute on the flood hot path
+        plan_stim = ""
+        if kind is None:
+            if ts.homed == "plan":
+                # a jax_placement plan home — NOT a shuffle "pin"
+                # (ts.homed carries the provenance): stamp the landed
+                # plan's stimulus so the row joins its kernel event
+                kind = "plan"
+                if self.placement is not None:
+                    plan_stim = getattr(self.placement, "plan_stim", "")
+            else:
+                kind = "placement"
+        prefix = ts.prefix
+        ts.ledger_row = self.ledger.file(
+            kind, ts.key, prefix.name if prefix is not None else "",
+            ws.address, stimulus_id, comm, measured, used,
+            dep_bytes, n_deps, duration, src, plan_stim,
+            supersede=ts.ledger_row,
+        )
+
+    def get_replica_cost_measured(
+        self, ts: TaskState, ws: WorkerState
+    ) -> tuple[float, bool]:
+        """Measured transfer price for moving ``ts``'s own payload to
+        ``ws`` (the AMM replica decision's cost): best measured holder
+        link, RTT fallback, constant fallback — the replica twin of
+        :meth:`get_comm_cost_measured`'s per-dep pricing."""
+        tel = self.telemetry
+        nb = ts.get_nbytes()
+        best_bw = 0.0
+        best_lat = -1.0
+        for hws in ts.who_has:
+            link = tel.links.get((hws.address, ws.address))
+            if link is not None and link.bandwidth.count:
+                bw = link.bandwidth.value
+                if bw > best_bw:
+                    best_bw = bw
+                    best_lat = link.latency.value
+        if best_bw > 0.0:
+            return nb / best_bw + best_lat, True
+        rtt = tel.rtt.get(ws.address, 0.0)
+        if rtt > 0.0:
+            return nb / self.bandwidth + rtt, True
+        return nb / self.bandwidth + self.transfer_latency, False
 
     def worker_objective(self, ts: TaskState, ws: WorkerState) -> tuple:
         """Lower is better (reference scheduler.py:3131 — plus a fixed
@@ -2489,6 +2641,9 @@ class SchedulerState:
                 {"key": key, "worker": worker},
                 stimulus_id,
             )
+        # an AMM drop decision for this (key, worker) realizes here
+        # (join_amm is a dict-emptiness check when no AMM rows pend)
+        self.ledger.join_amm(key, worker, "dropped")
         ts = self.tasks.get(key)
         ws = self.workers.get(worker)
         if ts is None or ws is None:
@@ -2550,6 +2705,11 @@ class SchedulerState:
             ts = self.tasks.get(key)
             if ts is not None and ts.state == "memory":
                 self.add_replica(ts, ws)
+                # an AMM replicate decision for this (key, worker)
+                # realizes here: acquire -> gather -> add-keys
+                self.ledger.join_amm(
+                    key, worker, "replicated", telemetry=self.telemetry,
+                )
             else:
                 redundant.append(key)
         if redundant:
@@ -2742,6 +2902,10 @@ class SchedulerState:
         del self.workers[address]
         self.aliases.pop(ws.name, None)
         self.telemetry.forget_worker(address)
+        # finalize open ledger rows pointing at the departed worker (the
+        # PR 7 link-leak lesson): their joins can never come, and the
+        # released cascade below must not mis-join them as cancellations
+        self.ledger.resolve_worker(address, now=self.clock())
         ws.status = WORKER_STATUS_CLOSED
         self.running.discard(ws)
         self.idle.pop(ws.address, None)

@@ -216,7 +216,19 @@ class WorkStealing:
         # move's stimulus id (telemetry.py; docs/observability.md)
         self.state.shadow_comm_cost(ts, thief, comm_cost, "steal",
                                     stimulus_id)
-        thief_duration = self.state.get_task_duration(ts) + comm_cost
+        compute = self.state.get_task_duration(ts)
+        thief_duration = compute + comm_cost
+        if self.state.ledger.enabled:
+            # decision ledger (ledger.py): the steal DECISION is priced
+            # here; this row supersedes the victim placement's open row.
+            # On confirm the re-placement files the definitive "steal"
+            # row (superseding this one in turn); a rejection joins it
+            # as "rejected", and a victim finishing first joins it as
+            # "overtaken" — steal regret never absorbs a realization
+            # from a worker the kernel didn't price.
+            self.state.ledger_file_decision(
+                ts, thief, stimulus_id, "steal", compute, comm_cost
+            )
         self.remove_key_from_stealable(ts)
         self.in_flight[key] = InFlightInfo(
             victim, thief, victim_duration, thief_duration, stimulus_id
@@ -263,7 +275,11 @@ class WorkStealing:
         self.state._exit_processing_common(ts)
         ts.state = "waiting"  # transient; re-enter processing on thief
         victim.long_running.discard(ts)
-        ws_msgs = self.state._add_to_processing(ts, thief, stimulus_id)
+        # ledger kind "steal-spec": the re-placement row supersedes the
+        # victim placement's open row in one step (no confirm leg)
+        ws_msgs = self.state._add_to_processing(
+            ts, thief, stimulus_id, kind="steal-spec"
+        )
         msgs = {victim.address: [{
             "op": "free-keys", "keys": [key], "stimulus_id": stimulus_id,
         }]}
@@ -313,7 +329,12 @@ class WorkStealing:
             ts.state = "waiting"  # transient; re-enter processing on thief
             duration = info.thief_duration
             victim.long_running.discard(ts)
-            ws_msgs = self.state._add_to_processing(ts, thief, stimulus_id)
+            # the definitive "steal" ledger row: supersedes the request
+            # row filed at move_task_request (whose lifetime records the
+            # confirm round trip) and joins at memory with the regret
+            ws_msgs = self.state._add_to_processing(
+                ts, thief, stimulus_id, kind="steal"
+            )
             self.count += 1
             self.log.append(
                 ("confirm", key, victim.address, thief.address)
@@ -322,6 +343,9 @@ class WorkStealing:
             self.scheduler.send_all({}, ws_msgs)
         else:
             # already executing (or gone): leave it
+            if ts.ledger_row >= 0:
+                self.state.ledger.join_row(ts.ledger_row, "rejected")
+                ts.ledger_row = -1
             self.log.append(("reject", key, state, victim.address))
 
     # ------------------------------------------------------------ balance

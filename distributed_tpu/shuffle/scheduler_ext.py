@@ -159,7 +159,11 @@ class ShuffleSchedulerExtension:
         for key in self._task_keys(st):
             ts = tasks.get(key)
             if ts is not None:
-                ts.homed = True
+                # "pin", not "plan": the flag stays truthy for the
+                # steal exemption, but the decision ledger must not
+                # attribute shuffle pins to the jax partition planner
+                # (ts.homed carries provenance; state.py TaskState)
+                ts.homed = "pin"
                 if stealing is not None:
                     # already-queued tasks entered stealable before the
                     # first worker registered this shuffle: purge them,

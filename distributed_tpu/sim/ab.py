@@ -37,6 +37,20 @@ def run_policy(
     report = sim.run()
     report["digest"] = sim.digest()
     report["config_overrides"] = dict(config_overrides or {})
+    # critical-path attribution (diagnostics/critical_path.py) while
+    # the run's tasks are still resident: per-arm "where did the
+    # makespan go", diffed below.  A run that completed nothing (or a
+    # chaos arm that forgot its path) reports None.
+    cp = sim.critical_path()
+    report["critical_path"] = (
+        {
+            "makespan": cp["makespan"],
+            "n_tasks": cp["n_tasks"],
+            "attribution": cp["attribution"],
+        }
+        if cp is not None
+        else None
+    )
     return report
 
 
@@ -67,6 +81,23 @@ def run_ab(
             return None
         return vb - va
 
+    def _regret_delta(model: str) -> float | None:
+        va = (a.get("ledger") or {}).get("regret_abs_mean", {}).get(model)
+        vb = (b.get("ledger") or {}).get("regret_abs_mean", {}).get(model)
+        if va is None or vb is None:
+            return None
+        return vb - va
+
+    cp_a = a.get("critical_path") or {}
+    cp_b = b.get("critical_path") or {}
+    cp_diff = None
+    if cp_a.get("attribution") and cp_b.get("attribution"):
+        cp_diff = {
+            phase: cp_b["attribution"].get(phase, 0.0)
+            - cp_a["attribution"].get(phase, 0.0)
+            for phase in cp_a["attribution"]
+        }
+
     return {
         "a": a,
         "b": b,
@@ -80,5 +111,12 @@ def run_ab(
             "steals": _delta("steals"),
             "scheduler_transitions": _delta("scheduler_transitions"),
             "events": _delta("events"),
+            # decision–outcome deltas (ledger.py): how each arm's
+            # realized regret and makespan attribution moved — the
+            # calibration/eval signal ROADMAP item 1's payoff gates
+            # will train against
+            "regret_abs_mean_constant": _regret_delta("constant"),
+            "regret_abs_mean_measured": _regret_delta("measured"),
+            "critical_path": cp_diff,
         },
     }

@@ -297,6 +297,12 @@ class ClusterSim:
     config_overrides:
         extra dot-path config overrides applied during construction AND
         during every ``run()`` window — the policy A/B driver's knob.
+    ledger_size:
+        decision-ledger ring rows (``None`` = the live config default).
+        Size it above the workload's peak concurrent open decisions and
+        every row joins — the virtual clock makes decision→outcome
+        joins exact, so ``run()`` reports zero unjoined rows and a
+        bit-identical ``state.ledger.digest()`` across same-seed runs.
     """
 
     def __init__(
@@ -313,6 +319,7 @@ class ClusterSim:
         validate: bool = False,
         use_device_kernels: bool = False,
         config_overrides: dict[str, Any] | None = None,
+        ledger_size: int | None = None,
     ):
         self.clock = VirtualClock()
         self.heap = EventHeap()
@@ -336,6 +343,8 @@ class ClusterSim:
             # the device-kernel gates read config at call time, so this
             # override must also wrap run() windows
             self._overrides["scheduler.jax.enabled"] = False
+        if ledger_size is not None:
+            self._overrides["scheduler.ledger.size"] = int(ledger_size)
         self._overrides.update(config_overrides or {})
 
         # deterministic per-run stimulus-id mint (seq_name is a
@@ -366,6 +375,11 @@ class ClusterSim:
                 mirror=None if self.use_device_kernels else False,
                 clock=self.clock,
             )
+            # decision-ledger digest (ledger.py): opt-in live (a blake2b
+            # fold per join), always on under the virtual clock — the
+            # same-seed bit-identical-ledger contract costs nothing a
+            # sim cares about
+            self.state.ledger.digest_enabled = True
             self.host = SimSchedulerHost(self, self.state)
             self.state.extensions = self.host.extensions
             self.workers: dict[str, SimWorker] = {}
@@ -943,6 +957,26 @@ class ClusterSim:
         self.state.plugins["sim-digest"] = plug
         return plug
 
+    def critical_path(self, t0: float = 0.0) -> dict | None:
+        """Critical-path attribution over this run's ledger rows and
+        the LIVE task graph (diagnostics/critical_path.py) — call
+        before releasing the terminal keys, while the path's tasks are
+        still resident.  The terminal is pinned to the workload's done
+        keys: a stolen duplicate finishing after the sink was computed
+        elsewhere must not extend the path past the makespan."""
+        from distributed_tpu.diagnostics.critical_path import (
+            critical_path,
+        )
+
+        deps = {
+            key: [d.key for d in ts.dependencies]
+            for key, ts in self.state.tasks.items()
+        }
+        return critical_path(
+            self.state.ledger.tail(), deps, t0=t0,
+            terminal_keys=self.keys_done or None,
+        )
+
     def report(self) -> dict:
         return {
             "n_workers": self.n_workers,
@@ -958,6 +992,10 @@ class ClusterSim:
             "steals": self.stealing.count,
             "counters": dict(self.counters),
             "faults": dict(self.faults),
+            # decision–outcome audit (ledger.py): per-kind regret for
+            # both cost models, join health, the ledger digest — the
+            # regret report the A/B driver diffs per arm
+            "ledger": self.state.ledger.summary(),
         }
 
 

@@ -378,8 +378,7 @@ class Worker(Server):
             from distributed_tpu.diagnostics.selfprofile import profile_jsonl
             from distributed_tpu.tracing import to_jsonl
 
-            self.http_server = HTTPServer(
-                {
+            routes: dict = {
                     "/health": lambda: "ok",
                     "/info": self.identity,
                     "/metrics": lambda: worker_metrics(self),
@@ -410,9 +409,17 @@ class Worker(Server):
                         ),
                         "application/x-ndjson",
                     ),
-                },
-                port=self._http_port,
-            )
+            }
+            # route index at "/": same discoverability contract as the
+            # scheduler role — one GET lists every route this node
+            # serves (the scheduler's index additionally lists /ledger;
+            # decisions are scheduler-side, so workers have no ledger)
+            routes["/"] = lambda: {
+                "role": "worker",
+                "id": self.id,
+                "routes": sorted(r for r in routes if r != "/"),
+            }
+            self.http_server = HTTPServer(routes, port=self._http_port)
             await self.http_server.start()
         # config preloads run BEFORE registration (reference worker
         # ordering): the scheduler may assign tasks the moment the

@@ -121,6 +121,23 @@ def test_bench_smoke_runs_and_reports():
     assert selfprofile["stall_events"] == 1
     assert selfprofile["stall_frame_named"] is True
     assert selfprofile["host_canary_ms"] > 0
+    # decision–outcome ledger (ledger.py, diagnostics/critical_path.py,
+    # docs/observability.md): ledger-on engine floods stay under the 5%
+    # budget, the file+join hot path allocates nothing, a small live
+    # cluster joins every decision, the telemetry-seeded non-uniform
+    # sim's measured-shadow regret beats the constants' (the ROADMAP
+    # item 1 calibration artifact), and critical-path attribution sums
+    # to the virtual makespan within 1% (the bench half raises on any
+    # violation; these asserts pin the contract in the gate's output)
+    ledger = out["configs"]["ledger"]
+    assert ledger["overhead_pct"] < 5.0
+    assert ledger["alloc_delta_blocks"] < 50
+    assert ledger["live_joined"] > 0
+    assert ledger["live_unjoined"] == 0
+    assert ledger["live_regret_rows"] > 0
+    assert ledger["regret_abs_measured"] < ledger["regret_abs_constant"]
+    assert ledger["cp_check_ok"] is True
+    assert ledger["cp_makespan_s"] > 0
     # sans-io cluster simulator (distributed_tpu/sim, docs/simulator.md):
     # two same-seed runs of the sim_10k miniature — real engines, steal
     # + AMM cycles live, virtual clock — produced BIT-IDENTICAL digests

@@ -899,6 +899,64 @@ async def test_trace_endpoint_and_histograms_live():
             assert float(count.split()[-1]) > 0
 
 
+@gen_test()
+async def test_route_index_ledger_and_build_info_live():
+    """The "/" route index lists every observability route on BOTH
+    roles, /ledger serves the decision–outcome snapshot on the
+    scheduler, and /metrics carries the dtpu_build_info identity gauge
+    (docs/observability.md "Decision ledger & critical-path")."""
+    import json as _json
+
+    from distributed_tpu.tracing import from_jsonl
+
+    async with await new_cluster() as cluster:
+        async with Client(cluster.scheduler_address) as c:
+            await c.gather(c.map(lambda x: x + 1, range(8), pure=False))
+            sport = cluster.scheduler.http_server.port
+            status, body = await http_get(sport, "/")
+            assert status == 200
+            idx = _json.loads(body)
+            assert idx["role"] == "scheduler"
+            assert {
+                "/metrics", "/trace", "/telemetry", "/profile", "/ledger",
+            } <= set(idx["routes"])
+            wport = cluster.workers[0].http_server.port
+            status, body = await http_get(wport, "/")
+            assert status == 200
+            widx = _json.loads(body)
+            assert widx["role"] == "worker"
+            assert {
+                "/metrics", "/trace", "/telemetry", "/profile",
+            } <= set(widx["routes"])
+            # /ledger: summary head + row tail, every flood placement
+            # joined to its memory outcome
+            status, body = await http_get(sport, "/ledger")
+            assert status == 200
+            recs = from_jsonl(body)
+            assert recs[0]["type"] == "ledger-summary"
+            assert recs[0]["outcomes"].get("memory", 0) >= 8
+            rows = [r for r in recs if r["type"] == "ledger-row"]
+            assert rows and all(r["v"] == 1 for r in rows)
+            # the RPC twin serves the same snapshot shape
+            rpc = await c.scheduler.get_ledger(n=4)
+            assert rpc[0]["type"] == "ledger-summary"
+            assert len(rpc) == 5
+            # build info on both roles
+            for port, role in ((sport, "scheduler"), (wport, "worker")):
+                status, body = await http_get(port, "/metrics")
+                line = [
+                    ln for ln in body.decode().splitlines()
+                    if ln.startswith("dtpu_build_info{")
+                ][0]
+                assert f'role="{role}"' in line
+                assert line.endswith(" 1")
+            # ledger regret families made it to the exposition
+            status, body = await http_get(sport, "/metrics")
+            text = body.decode()
+            assert "dtpu_ledger_rows_total" in text
+            assert "dtpu_ledger_joined_total" in text
+
+
 def test_rate_limiter_filter():
     import logging
 
@@ -1023,6 +1081,17 @@ def test_metrics_names_unique_and_documented():
         ["execute", "", "inc", "count", "tasks", 2],
     ])
     tel.observe_divergence(1.0, 0.1, True)
+    # seed the decision ledger so every dtpu_ledger_* family is
+    # exercised (ledger.py; docs/observability.md "Decision ledger"):
+    # one joined dep-bearing row populates the regret histograms and
+    # the per-prefix/per-link aggregates, one open row the gauge
+    led = _Sched.state.ledger
+    h = led.file(
+        "placement", "pm-led-k", "inc", "tcp://pm:2", "pm-stim",
+        0.01, 0.02, True, 4096, 1, 0.5, "tcp://pm:1", "",
+    )
+    led.join_row(h, "memory", "tcp://pm:2", None, 0.4, tel)
+    led.file("steal", "pm-led-open", "inc", "tcp://pm:2", "pm-stim2")
     # seed the sharded-engine + sharded-mirror families (the mesh plan
     # path, PR 8): a real sharded_device_view over the conftest CPU
     # mesh populates the per-shard mirror counters, and one folded
@@ -1105,6 +1174,20 @@ def test_metrics_names_unique_and_documented():
             "dtpu_costmodel_divergence_ratio_count",
             "dtpu_costmodel_shadow_evals_total",
             "dtpu_costmodel_shadow_measured_total",
+            "dtpu_build_info",
+            "dtpu_ledger_rows_total",
+            "dtpu_ledger_joined_total",
+            "dtpu_ledger_unjoined_total",
+            "dtpu_ledger_superseded_total",
+            "dtpu_ledger_open_rows",
+            "dtpu_ledger_regret_seconds_bucket",
+            "dtpu_ledger_regret_seconds_sum",
+            "dtpu_ledger_regret_seconds_count",
+            "dtpu_ledger_prefix_regret_seconds_total",
+            "dtpu_ledger_prefix_decisions_total",
+            "dtpu_ledger_link_regret_seconds_total",
+            "dtpu_ledger_link_transfer_seconds_total",
+            "dtpu_ledger_link_decisions_total",
             "dtpu_mirror_shard_rows_uploaded_total",
             "dtpu_mirror_shard_bytes_uploaded_total",
             "dtpu_mirror_shard_full_packs_total",
