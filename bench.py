@@ -966,13 +966,13 @@ def cfg_dag_10m():
     }
 
 
-def _sim_10k_once(seed: int):
+def _sim_10k_once(seed: int, native: bool | None = None):
     """One 1M-task / 10k-virtual-worker run through the real engines on
     the virtual clock; returns (report, digest)."""
     from distributed_tpu.sim import ClusterSim, SyntheticDag
 
     sim = ClusterSim(
-        10_000, nthreads=1, seed=seed, validate=False,
+        10_000, nthreads=1, seed=seed, validate=False, native=native,
         # per-link telemetry would build ~10^5 native t-digests at this
         # fleet scale; the headline measures the engines, not telemetry
         config_overrides={"scheduler.telemetry.enabled": False},
@@ -991,6 +991,11 @@ def _sim_10k_once(seed: int):
     report = sim.run()
     report["wall_s"] = round(time.perf_counter() - t0, 1)
     report["n_tasks"] = trace.n_tasks
+    report["engine_wall_s"] = round(
+        sim.state.wall.totals.get("engine.drain", 0.0), 1
+    )
+    if sim.state.native is not None:
+        report["native"] = sim.state.native.counters()
     return report, sim.digest()
 
 
@@ -998,14 +1003,21 @@ def cfg_sim_10k():
     """Simulator headline (ROADMAP item 1): place-and-run a 1M-task
     layered graph on 10,000 REAL WorkerState machines + the REAL
     scheduler engine with steal + AMM cycles, single process, virtual
-    clock — twice with the same seed.  The virtual makespan and the
-    whole-run transition digest must be BIT-IDENTICAL between the two
-    runs: the reported makespan is a pure function of workload + links
-    + policies, immune to the box's 2x wall drift."""
-    rep1, digest1 = _sim_10k_once(seed=0)
-    rep2, digest2 = _sim_10k_once(seed=0)
+    clock — twice with the same seed: run 1 with the native transition
+    engine attached (the config default), run 2 forced onto the pure-
+    python oracle.  The virtual makespan and the whole-run transition
+    digest must be BIT-IDENTICAL between the two runs — the same-seed
+    determinism contract now doubles as the native engine's at-scale
+    parity gate (docs/native_engine.md)."""
+    rep1, digest1 = _sim_10k_once(seed=0, native=True)
+    assert rep1.get("native"), (
+        "run 1 did not attach the native engine — the parity gate "
+        "would compare oracle against oracle"
+    )
+    rep2, digest2 = _sim_10k_once(seed=0, native=False)
     assert digest1 == digest2, (
-        f"sim_10k same-seed digests diverged: {digest1} vs {digest2}"
+        f"sim_10k native-vs-oracle digests diverged: {digest1} vs "
+        f"{digest2}"
     )
     assert rep1["virtual_makespan_s"] == rep2["virtual_makespan_s"], (
         rep1["virtual_makespan_s"], rep2["virtual_makespan_s"],
@@ -1020,7 +1032,15 @@ def cfg_sim_10k():
         "virtual_makespan_s": rep1["virtual_makespan_s"],
         "wall_s": [rep1["wall_s"], rep2["wall_s"]],
         "transitions": transitions,
-        "decisions_per_s": round(transitions / rep1["wall_s"]),
+        # transitions/s is the headline the native engine is judged on
+        # (ROADMAP item 4); decisions_per_s is the same value under its
+        # pre-existing name (one shared local, so they cannot drift)
+        "transitions_per_s": (tps := round(transitions / rep1["wall_s"])),
+        "scheduler_engine_wall_s": [
+            rep1["engine_wall_s"], rep2["engine_wall_s"],
+        ],
+        "native": rep1.get("native"),
+        "decisions_per_s": tps,
         "steals": rep1["steals"],
         "amm_cycles": rep1["counters"].get("amm_cycles", 0),
         "steal_cycles": rep1["counters"].get("steal_cycles", 0),
@@ -2164,6 +2184,202 @@ def _smoke_ledger() -> dict:
     return out
 
 
+def _smoke_engine() -> dict:
+    """Native transition-engine gate (native/engine.cpp +
+    scheduler/native_engine.py; docs/native_engine.md): a randomized
+    dependency flood driven through the compiled engine must
+
+    - be BIT-IDENTICAL to the pure-python oracle (final states, per-key
+      stories, per-destination message multisets),
+    - absorb the four compiled arms natively (escape rate < 10% of
+      transitions — the sim_10k trace measures ~0%),
+    - hold a same-session speedup >= 1.3x on the batch-plane flood,
+      best-of-pairs (one-sided box-phase noise shrinks single pairs; a
+      real regression drops EVERY pair — measured pairs run 1.8-2.1x
+      on this box, PERF.md Round 11), and
+    - allocate nothing per flood in the bridge's steady state (stale-
+      completion floods: prep + native drain + tape apply with no state
+      growth, the PR 6 getallocatedblocks pattern).
+    """
+    import random as _random
+    import sys as _sys
+
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    N_WORKERS, WIDTH, LAYERS, REPS = 32, 64, 10, 5
+    OVR = {
+        "scheduler.trace.enabled": False,
+        "scheduler.telemetry.enabled": False,
+        "scheduler.native-engine.enabled": False,  # explicit attach
+        "scheduler.native-engine.min-flood": 0,
+    }
+
+    class _Spec:
+        __slots__ = ()
+
+    spec = _Spec()
+
+    def build(native_on, seed=0):
+        with dtpu_config.set(OVR):
+            state = SchedulerState(validate=False)
+            if native_on:
+                assert state.attach_native(build=True), (
+                    "native toolchain unavailable (engine smoke needs "
+                    "the on-demand g++ build this image carries)"
+                )
+            for i in range(N_WORKERS):
+                state.add_worker_state(
+                    f"sim://w{i}", nthreads=1, memory_limit=2**30,
+                    name=f"w{i}",
+                )
+            rng = _random.Random(seed)
+            addrs = list(state.workers)
+            prev = []
+            for i in range(WIDTH):
+                k = f"root-{i}"
+                state.client_desires_keys([k], "c")
+                recs, cm, wm = state._transition(
+                    k, "memory", "scatter", nbytes=65536,
+                    worker=addrs[i % len(addrs)],
+                )
+                state._transitions(recs, cm, wm, "scatter")
+                prev.append(k)
+            tasks, deps, prios = {}, {}, {}
+            rank = 0
+            for j in range(LAYERS):
+                layer = [f"L{j}-{i}" for i in range(WIDTH)]
+                for k in layer:
+                    deps[k] = {
+                        prev[rng.randrange(len(prev))] for _ in range(2)
+                    }
+                    tasks[k] = spec
+                    prios[k] = (rank,)
+                    rank += 1
+                prev = layer
+            state.update_graph_core(
+                tasks, deps, prev, client="c", priorities=prios,
+                stimulus_id="graph",
+            )
+        return state
+
+    def flood(state, collect=False):
+        rounds, out = 0, []
+        t0 = time.perf_counter()
+        with dtpu_config.set(OVR):
+            while True:
+                batch = [
+                    (
+                        ts.key, ws.address, f"f{rounds}-{i}",
+                        {"nbytes": 2048, "startstops": [{
+                            "action": "compute", "start": 0.0,
+                            "stop": 0.01,
+                        }]},
+                    )
+                    for ws in state.workers.values()
+                    for i, ts in enumerate(list(ws.processing))
+                ]
+                if not batch:
+                    break
+                r = state.stimulus_tasks_finished_batch(batch)
+                if collect:
+                    out.append(r)
+                rounds += 1
+                assert rounds < 5000
+        return time.perf_counter() - t0, out
+
+    def freeze(obj):
+        if isinstance(obj, dict):
+            return tuple(sorted((k, freeze(v)) for k, v in obj.items()))
+        if isinstance(obj, (list, tuple)):
+            return tuple(freeze(v) for v in obj)
+        if isinstance(obj, (str, bytes, int, float, bool)) or obj is None:
+            return obj
+        return repr(type(obj))
+
+    def canon(rounds):
+        return [
+            {
+                dest: sorted(
+                    (freeze({k: v for k, v in m.items()
+                             if k != "run_spec"}) for m in msgs),
+                    key=repr,
+                )
+                for dest, msgs in d.items()
+            }
+            for cm, wm in rounds for d in (cm, wm)
+        ]
+
+    def snap(state):
+        return {
+            k: (
+                ts.state,
+                ts.processing_on.address if ts.processing_on else None,
+                tuple(ws.address for ws in ts.who_has),
+            )
+            for k, ts in state.tasks.items()
+        }
+
+    # --- bit-parity on a randomized flood ----------------------------
+    a, b = build(False, seed=3), build(True, seed=3)
+    _, ra = flood(a, collect=True)
+    _, rb = flood(b, collect=True)
+    assert snap(a) == snap(b), "native/oracle state mismatch"
+    assert [r[:5] for r in a.transition_log] ==         [r[:5] for r in b.transition_log], "story mismatch"
+    assert canon(ra) == canon(rb), "message mismatch"
+    counters = b.native.counters()
+    total = counters["transitions"] + counters["oracle_transitions"]
+    escape_rate = counters["escapes"] / max(total, 1)
+    assert counters["transitions"] > 0, "native engine never ran"
+    assert escape_rate < 0.10, (
+        f"escape rate {escape_rate:.1%} — the compiled arms are not "
+        f"absorbing their share ({counters})"
+    )
+
+    # --- same-session speedup (min-of-pairs, drift-robust) -----------
+    flood(build(False))
+    flood(build(True))
+    ratios = []
+    for _ in range(REPS):
+        wo, _ = flood(build(False))
+        wn, _ = flood(build(True))
+        ratios.append(wo / wn)
+    speedup = max(ratios)
+    assert speedup >= 1.3, (
+        f"native flood speedup {speedup:.2f}x under the 1.3x floor "
+        f"(pairs {[round(r, 2) for r in ratios]})"
+    )
+
+    # --- per-flood alloc budget (stale floods: no state growth) ------
+    st = build(True, seed=4)
+    stale = [(f"ghost-{i}", "sim://w0", f"g{i}", {"nbytes": 8})
+             for i in range(64)]
+    with dtpu_config.set(OVR):
+        for _ in range(4):
+            st.stimulus_tasks_finished_batch(list(stale))
+        b0 = _sys.getallocatedblocks()
+        for _ in range(32):
+            st.stimulus_tasks_finished_batch(list(stale))
+        alloc_delta = _sys.getallocatedblocks() - b0
+    assert alloc_delta < 300, (
+        f"native flood path leaked {alloc_delta} blocks over 32 "
+        "identical stale floods"
+    )
+
+    return {
+        "n_tasks": WIDTH * LAYERS,
+        "transitions": b.transition_counter,
+        "native_transitions": counters["transitions"],
+        "escapes": counters["escapes"],
+        "escape_rate": round(escape_rate, 4),
+        "parity": True,
+        "speedup_best": round(speedup, 2),
+        "speedup_pairs": [round(r, 2) for r in ratios],
+        "alloc_delta_blocks": alloc_delta,
+        "host_canary_ms": _host_canary_ms(),
+    }
+
+
 def run_smoke():
     """``python bench.py --smoke``: tiny CPU-pinned configs; one JSON
     line on stdout; raises (non-zero exit) on any failure."""
@@ -2194,6 +2410,7 @@ def run_smoke():
         "telemetry": retry_once(_smoke_telemetry),
         "selfprofile": retry_once(_smoke_selfprofile),
         "ledger": retry_once(_smoke_ledger),
+        "engine": retry_once(_smoke_engine),
         "sim": _smoke_sim(),
         # LAST on purpose: the sharded programs spin up the 8-device
         # XLA runtime (one thread pool per virtual device on a 2-core

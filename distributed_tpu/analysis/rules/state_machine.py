@@ -20,6 +20,7 @@ whole-program rule extracts the full model (analysis/model/) and flags:
 
 from __future__ import annotations
 
+import ast
 from typing import Iterator
 
 from distributed_tpu.analysis.core import Finding, LintContext, Rule, register
@@ -28,6 +29,39 @@ from distributed_tpu.analysis.model.state_machine import (
     extract_machines,
     reachable_set,
 )
+
+#: the module that declares the native engine's compiled arm set
+_NATIVE_BRIDGE = "distributed_tpu/scheduler/native_engine.py"
+
+
+def _compiled_arms(tree: ast.AST) -> tuple[int, list[tuple[str, str]]]:
+    """The (line, pairs) of the COMPILED_ARMS literal in the native
+    bridge; (0, []) when absent."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "COMPILED_ARMS"
+            for t in node.targets
+        ):
+            continue
+        pairs: list[tuple[str, str]] = []
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for el in node.value.elts:
+                if (
+                    isinstance(el, (ast.Tuple, ast.List))
+                    and len(el.elts) == 2
+                    and all(
+                        isinstance(x, ast.Constant)
+                        and isinstance(x.value, str)
+                        for x in el.elts
+                    )
+                ):
+                    pairs.append(
+                        (el.elts[0].value, el.elts[1].value)  # type: ignore
+                    )
+        return node.lineno, pairs
+    return 0, []
 
 
 @register
@@ -44,6 +78,48 @@ class StateMachineRule(Rule):
         modules = ctx.modules(self)
         machines = extract_machines(modules)
         mods_by_path = {m.relpath: m for m in modules}
+
+        # ---- 4. the native engine's compiled arm set must be a subset
+        # of the extracted SCHEDULER table: a new arm added (or renamed)
+        # in python but silently missing from the C++ core shows up as
+        # a finding here, not as an escape-rate perf cliff in prod
+        bridge = mods_by_path.get(_NATIVE_BRIDGE)
+        sched_machine = next(
+            (m for m in machines if "scheduler" in m.module), None
+        )
+        if bridge is not None and sched_machine is not None:
+            line, arms = _compiled_arms(bridge.tree)
+            if not arms:
+                yield Finding(
+                    rule=self.name,
+                    path=_NATIVE_BRIDGE,
+                    line=line or 1,
+                    col=0,
+                    symbol="COMPILED_ARMS",
+                    message=(
+                        "native bridge declares no COMPILED_ARMS "
+                        "literal; the compiled-arm/table subset check "
+                        "cannot run"
+                    ),
+                )
+            table_pairs = {
+                (t.start, t.finish) for t in sched_machine.transitions
+            }
+            for pair in arms:
+                if pair not in table_pairs:
+                    yield Finding(
+                        rule=self.name,
+                        path=_NATIVE_BRIDGE,
+                        line=line,
+                        col=0,
+                        symbol="COMPILED_ARMS",
+                        message=(
+                            f"compiled arm {pair!r} is not an edge of "
+                            f"the extracted {sched_machine.name} "
+                            "transition table — the C++ core and "
+                            "state.py have drifted"
+                        ),
+                    )
 
         for machine in machines:
             table = machine.table

@@ -179,6 +179,21 @@ defaults: dict[str, Any] = {
             "enabled": True,
             "size": 16384,   # rows resident (rounded up to a power of two)
         },
+        # native (C++) transition engine for the four dominant scheduler
+        # arms (scheduler/native_engine.py; docs/native_engine.md).
+        # Degrades to the pure-python oracle when the toolchain is
+        # missing or DTPU_NATIVE_DISABLE is set; DTPU_NATIVE_CHECK runs
+        # the per-flood SoA<->python parity audit.
+        "native-engine": {
+            "enabled": True,
+            # floods below this many events run the pure-python oracle.
+            # Default 0 (native whenever attached): the SoA maintenance
+            # hooks are paid regardless, so routing small floods to the
+            # oracle only helps when the knob is paired with an
+            # (unattached) engine — measured 0.78x at min-flood=12 vs
+            # 1.11x at 0 on the 1000-worker sim (PERF.md Round 11).
+            "min-flood": 0,
+        },
         "active-memory-manager": {
             "start": True,
             "interval": "2s",

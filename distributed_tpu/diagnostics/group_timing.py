@@ -36,6 +36,12 @@ class GroupTimingPlugin:
         self.buckets: dict[int, dict[str, float]] = {}
         scheduler.state.plugins[self.name] = self
 
+    # tape-safe (scheduler/native_engine.py): this hook reads only its
+    # arguments, row-current task state and plugin-private structures,
+    # never WorkerState.occupancy — so the native engine's applier may
+    # replay it per tape row in stream order (docs/native_engine.md)
+    tape_safe = True
+
     def transition(self, key: str, start: str, finish: str, *args: Any,
                    **kwargs: Any) -> None:
         if start != "processing" or finish != "memory":

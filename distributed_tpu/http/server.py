@@ -752,6 +752,49 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                     {"shard": str(shard_i)},
                 )
             )
+    # native transition engine (scheduler/native_engine.py): compiled
+    # vs escaped transition totals — an escape-rate regression (a new
+    # arm or flag the C++ core does not model) is visible here long
+    # before it is a perf cliff
+    ne = getattr(s, "native", None)
+    if ne is not None:
+        c = ne.counters()
+        lines.append(
+            "# HELP dtpu_engine_native_transitions_total Transitions "
+            "executed by the compiled (C++) engine"
+        )
+        lines.append("# TYPE dtpu_engine_native_transitions_total counter")
+        lines.append(
+            prom_line("dtpu_engine_native_transitions_total",
+                      c["transitions"])
+        )
+        lines.append(
+            "# HELP dtpu_engine_native_escapes_total Per-key escapes "
+            "from the compiled engine to the python oracle, by reason"
+        )
+        lines.append("# TYPE dtpu_engine_native_escapes_total counter")
+        lines.append(
+            prom_line("dtpu_engine_native_escapes_total", c["escapes"],
+                      {"why": "all"})
+        )
+        for k, v in c.items():
+            if k.startswith("escape_"):
+                lines.append(
+                    prom_line("dtpu_engine_native_escapes_total", v,
+                              {"why": k[len("escape_"):]})
+                )
+        lines.append(
+            "# HELP dtpu_engine_native_oracle_transitions_total "
+            "Transitions run by the python oracle while the native "
+            "engine was attached (escape chains + fallback floods)"
+        )
+        lines.append(
+            "# TYPE dtpu_engine_native_oracle_transitions_total counter"
+        )
+        lines.append(
+            prom_line("dtpu_engine_native_oracle_transitions_total",
+                      c["oracle_transitions"])
+        )
     # batched-engine + egress-coalescer histograms (tracing.Histogram,
     # observed in scheduler/state.py and Scheduler.stream_payload_flush)
     for name, hist, help_ in (

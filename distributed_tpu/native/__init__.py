@@ -3,44 +3,80 @@
 The reference obtains native speed from C dependencies (msgpack, lz4,
 crick, ucx — SURVEY §2); this package holds our own equivalents.  The
 shared library builds once per machine into the package directory with
-``g++ -O2 -shared`` and every consumer has a pure-python fallback, so a
-missing toolchain degrades gracefully.
+``g++ -O3 -shared`` and every consumer has a pure-python fallback, so a
+missing toolchain degrades gracefully.  ``DTPU_NATIVE_DISABLE=1``
+forces the pure-python fallbacks everywhere (the no-toolchain path,
+testable on a box that has g++).
+
+Rebuild keying: the library is stale when any source is newer than it
+OR when the compile command (flags + source list) changed since it was
+built — the command is recorded in a ``.buildinfo`` sidecar, so editing
+``_SOURCES`` or the flags takes effect without touching a source file.
 """
 
 from __future__ import annotations
 
 import ctypes
+import json
 import logging
 import os
 import subprocess
 import threading
+from typing import Callable
 
 logger = logging.getLogger("distributed_tpu.native")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "_dtpu_native.so")
+_BUILDINFO_PATH = _LIB_PATH + ".buildinfo"
 _SOURCES = [
     os.path.join(_HERE, "tdigest.cpp"),
     os.path.join(_HERE, "graphpack.cpp"),
+    os.path.join(_HERE, "engine.cpp"),
 ]
+# NO -ffast-math and no reassociation flags, ever: engine.cpp promises
+# bit-identical IEEE rounding with CPython's float ops
+_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_failed = False
 
 
+def disabled() -> bool:
+    """True when the DTPU_NATIVE_DISABLE env kill-switch is set: every
+    consumer silently uses its pure-python fallback."""
+    return os.environ.get("DTPU_NATIVE_DISABLE", "") not in ("", "0")
+
+
+def _build_spec() -> dict:
+    """The identity of the compile command: what the ``.buildinfo``
+    sidecar records and what staleness is keyed on (basenames so a
+    relocated checkout does not rebuild)."""
+    return {
+        "flags": list(_FLAGS),
+        "sources": [os.path.basename(s) for s in _SOURCES],
+    }
+
+
 def _needs_build() -> bool:
     if not os.path.exists(_LIB_PATH):
+        return True
+    # command drift: editing _SOURCES or _FLAGS must invalidate the
+    # library even when no source file mtime moved
+    try:
+        with open(_BUILDINFO_PATH) as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        return True
+    if recorded != _build_spec():
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
     return any(os.path.getmtime(src) > lib_mtime for src in _SOURCES)
 
 
 def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        *_SOURCES, "-o", _LIB_PATH,
-    ]
+    cmd = ["g++", *_FLAGS, *_SOURCES, "-o", _LIB_PATH]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -51,14 +87,30 @@ def _build() -> bool:
             "native build failed:\n%s", proc.stderr.decode()[-2000:]
         )
         return False
+    try:
+        with open(_BUILDINFO_PATH, "w") as f:
+            json.dump(_build_spec(), f)
+    except OSError as e:  # stale-able but functional
+        logger.warning("could not record native buildinfo: %s", e)
     return True
 
 
-def prebuild_async() -> None:
+def prebuild_async(on_ready: Callable[[], None] | None = None) -> None:
     """Kick off the g++ build on a daemon thread (servers call this at
-    start so the first Digest() on the event loop never blocks on a
-    compile)."""
-    threading.Thread(target=load, name="dtpu-native-build", daemon=True).start()
+    start so the first native consumer on the event loop never blocks
+    on a compile).  ``on_ready`` fires IN THE BUILD THREAD when the
+    library is loaded — callers on an event loop must trampoline with
+    ``call_soon_threadsafe`` (the scheduler server uses this to attach
+    the native transition engine once the build lands)."""
+
+    def run() -> None:
+        if load() is not None and on_ready is not None:
+            try:
+                on_ready()
+            except Exception:
+                logger.exception("native prebuild on_ready callback failed")
+
+    threading.Thread(target=run, name="dtpu-native-build", daemon=True).start()
 
 
 def load_nowait() -> ctypes.CDLL | None:
@@ -67,7 +119,7 @@ def load_nowait() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None:
             return _lib
-        if _build_failed or _needs_build():
+        if _build_failed or disabled() or _needs_build():
             return None
     return load()
 
@@ -78,7 +130,7 @@ def load() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None:
             return _lib
-        if _build_failed:
+        if _build_failed or disabled():
             return None
         if _needs_build() and not _build():
             _build_failed = True
@@ -144,5 +196,81 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_int64, _i32p, _i32p, _i32p,
             ctypes.POINTER(ctypes.c_int8),
         ]
+        # ---- engine.cpp (scheduler/native_engine.py bridge)
+        _i64p = ctypes.POINTER(ctypes.c_int64)
+        _f64p = ctypes.POINTER(ctypes.c_double)
+        _u8p = ctypes.POINTER(ctypes.c_uint8)
+        _vp = ctypes.c_void_p
+        lib.eng_new.restype = _vp
+        lib.eng_new.argtypes = []
+        lib.eng_free.argtypes = [_vp]
+        lib.eng_params.argtypes = [
+            _vp, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.eng_worker_upsert.argtypes = [
+            _vp, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p,
+        ]
+        lib.eng_worker_close.argtypes = [_vp, ctypes.c_int32]
+        lib.eng_prefix_set.argtypes = [_vp, ctypes.c_int32, ctypes.c_double]
+        lib.eng_prefix_get.restype = ctypes.c_double
+        lib.eng_prefix_get.argtypes = [_vp, ctypes.c_int32]
+        lib.eng_group_upsert.argtypes = [
+            _vp, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, _i32p
+        ]
+        lib.eng_task_sync_bulk.argtypes = [
+            _vp, ctypes.c_int64, _i32p, _u8p, _u8p, _i32p, _i32p,
+            _i64p, _i32p, _i32p, _f64p,
+            _i64p, _i32p, _u8p,
+            _i64p, _i32p,
+            _i64p, _i32p,
+            _i64p, _i32p,
+        ]
+        lib.eng_task_forget.argtypes = [_vp, ctypes.c_int32]
+        lib.eng_set_tape.argtypes = [
+            _vp, _i32p, _i32p, _i32p, _i32p, _f64p, _f64p, ctypes.c_int64
+        ]
+        lib.eng_drain_finished.restype = ctypes.c_int32
+        lib.eng_drain_finished.argtypes = [
+            _vp, ctypes.c_int64, _i32p, _i32p, _i64p, _f64p, _u8p, _i64p
+        ]
+        lib.eng_drain_recs.restype = ctypes.c_int32
+        lib.eng_drain_recs.argtypes = [_vp, ctypes.c_int64, _i32p, _i32p]
+        lib.eng_tape_len.restype = ctypes.c_int64
+        lib.eng_tape_len.argtypes = [_vp]
+        lib.eng_escape_row.restype = ctypes.c_int32
+        lib.eng_escape_row.argtypes = [_vp]
+        lib.eng_escape_target.restype = ctypes.c_int32
+        lib.eng_escape_target.argtypes = [_vp]
+        lib.eng_escape_why.restype = ctypes.c_int32
+        lib.eng_escape_why.argtypes = [_vp]
+        lib.eng_pending_recs.restype = ctypes.c_int64
+        lib.eng_pending_recs.argtypes = [_vp, _i32p, _i32p, ctypes.c_int64]
+        lib.eng_touched.restype = ctypes.c_int64
+        lib.eng_touched.argtypes = [_vp, _i32p, _f64p, ctypes.c_int64]
+        lib.eng_total_occupancy.restype = ctypes.c_double
+        lib.eng_total_occupancy.argtypes = [_vp]
+        lib.eng_transitions.restype = ctypes.c_int64
+        lib.eng_transitions.argtypes = [_vp]
+        lib.eng_escapes.restype = ctypes.c_int64
+        lib.eng_escapes.argtypes = [_vp]
+        lib.eng_escape_count.restype = ctypes.c_int64
+        lib.eng_escape_count.argtypes = [_vp, ctypes.c_int32]
+        lib.eng_replica_add.argtypes = [_vp, ctypes.c_int32, ctypes.c_int32]
+        lib.eng_replica_remove.argtypes = [
+            _vp, ctypes.c_int32, ctypes.c_int32
+        ]
+        lib.eng_task_nbytes.argtypes = [
+            _vp, ctypes.c_int32, ctypes.c_int64
+        ]
+        lib.eng_task_who_wants.argtypes = [
+            _vp, ctypes.c_int32, ctypes.c_int32
+        ]
+        lib.eng_task_read.argtypes = [_vp, ctypes.c_int32, _i64p]
+        lib.eng_worker_read.argtypes = [_vp, ctypes.c_int32, _f64p, _i64p]
         _lib = lib
         return _lib

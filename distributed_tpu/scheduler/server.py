@@ -262,7 +262,21 @@ class Scheduler(Server):
         from distributed_tpu import native
 
         self._loop = asyncio.get_running_loop()
-        native.prebuild_async()
+        # async prebuild so the first flood never pays the g++ compile
+        # on the event loop; once the library lands, attach the native
+        # transition engine (state init could not — load_nowait returns
+        # None until the build exists)
+        loop = self._loop
+
+        def _native_ready() -> None:  # runs in the build thread
+            # same gate as SchedulerState.__init__: a validate=True
+            # scheduler must not pay SoA maintenance for an engine
+            # active() will never admit
+            if (config.get("scheduler.native-engine.enabled")
+                    and not self.state.validate):
+                loop.call_soon_threadsafe(self.state.attach_native)
+
+        native.prebuild_async(on_ready=_native_ready)
         addr = self._listen_addr or "tcp://127.0.0.1:0"
         listen_args = (
             self.security.get_listen_args("scheduler")

@@ -53,7 +53,7 @@ from distributed_tpu.tracing import (
     FlightRecorder,
     Histogram,
 )
-from distributed_tpu.utils import HeapSet, key_split, time
+from distributed_tpu.utils import HeapSet, OrderedSet, key_split, time
 
 logger = logging.getLogger("distributed_tpu.scheduler")
 
@@ -242,6 +242,7 @@ class TaskState:
         "queueable",
         "homed",
         "ledger_row",
+        "nrow",
         "_rootish",
         "_hash",
     )
@@ -252,12 +253,18 @@ class TaskState:
         self.run_spec = run_spec
         self.priority: tuple | None = None
         self.state = state
-        self.dependencies: set[TaskState] = set()
-        self.dependents: set[TaskState] = set()
-        self.waiting_on: set[TaskState] = set()
-        self.waiters: set[TaskState] = set()
+        # relation fields are insertion-ordered (utils.collections.
+        # OrderedSet), NOT hash-ordered sets: the transition engine's
+        # recommendation order derives from iterating them, so this is
+        # what makes engine outcomes deterministic across processes —
+        # and what the native engine's SoA mirror (native_engine.py)
+        # reproduces with plain C++ vectors
+        self.dependencies: OrderedSet[TaskState] = OrderedSet()
+        self.dependents: OrderedSet[TaskState] = OrderedSet()
+        self.waiting_on: OrderedSet[TaskState] = OrderedSet()
+        self.waiters: OrderedSet[TaskState] = OrderedSet()
         self.who_wants: set[ClientState] = set()
-        self.who_has: set[WorkerState] = set()
+        self.who_has: OrderedSet[WorkerState] = OrderedSet()
         self.processing_on: WorkerState | None = None
         self.nbytes = -1
         self.type: str | None = None
@@ -292,6 +299,9 @@ class TaskState:
         # file/join hot path pays no string hash; stale handles are
         # validity-checked by the ledger.
         self.ledger_row = -1
+        # stable row in the native engine's SoA (scheduler/
+        # native_engine.py): -1 = not registered
+        self.nrow = -1
         self._rootish: bool | None = None
 
     def __repr__(self) -> str:
@@ -372,6 +382,7 @@ class WorkerState:
         "extra",
         "server_id",
         "idx",
+        "nidx",
     )
 
     def __init__(
@@ -411,6 +422,7 @@ class WorkerState:
         self.extra: dict = {}
         self.server_id = server_id or address
         self.idx = -1  # stable slot in the device mirror (ops/)
+        self.nidx = -1  # stable slot in the native engine SoA
 
     def __repr__(self) -> str:
         return (
@@ -597,6 +609,15 @@ class SchedulerState:
                     config.get("scheduler.jax.capacity-doubling")
                 ),
             )
+        # native (C++) transition engine for the four dominant arms
+        # (scheduler/native_engine.py; docs/native_engine.md).  None =
+        # the pure-python oracle runs everything.  Attach never blocks
+        # on a g++ compile here: servers prebuild asynchronously and
+        # re-attach on the ready callback; sim/bench contexts call
+        # attach_native(build=True) explicitly.
+        self.native: Any | None = None
+        if config.get("scheduler.native-engine.enabled") and not self.validate:
+            self.attach_native()
         self.extensions: dict[str, Any] = {}
         self.events_subscriber_hook: Callable | None = None
         self.events: defaultdict[str, deque] = defaultdict(
@@ -607,6 +628,17 @@ class SchedulerState:
         self.unknown_durations: dict[str, set[TaskState]] = {}
 
     # ------------------------------------------------------------------ misc
+
+    def attach_native(self, build: bool = False) -> bool:
+        """Attach the native transition engine if the compiled library
+        is available (``build=True`` compiles on demand — only call off
+        the event loop).  Idempotent; returns True when attached."""
+        if self.native is not None:
+            return True
+        from distributed_tpu.scheduler.native_engine import NativeEngine
+
+        self.native = NativeEngine.attach(self, build=build)
+        return self.native is not None
 
     @property
     def memory_total(self) -> int:
@@ -642,6 +674,8 @@ class SchedulerState:
         tg.add(ts)
         self.tasks[key] = ts
         self.n_tasks += 1
+        if self.native is not None:
+            self.native.on_new_task(ts)
         return ts
 
     def _clear_task_state(self) -> None:
@@ -674,6 +708,8 @@ class SchedulerState:
         # open decision rows reference the cleared tasks: close them so
         # they don't age out as false unjoineds after a restart
         self.ledger.resolve_all("released", now=self.clock())
+        if self.native is not None:
+            self.native.reset()
 
     # ------------------------------------------------- transition engine
 
@@ -749,6 +785,10 @@ class SchedulerState:
                         logger.exception("Plugin %r failed in transition", plugin)
             return recommendations, client_msgs, worker_msgs
         finally:
+            # native SoA delta-consistency: an oracle transition may
+            # have touched ts and both relation neighborhoods
+            if self.native is not None:
+                self.native.mark_transition(ts)
             if arms:
                 self.wall.pop()
 
@@ -786,6 +826,29 @@ class SchedulerState:
                 if ts is not None:
                     self.validate_task_state(ts)
 
+    def _drain_round(
+        self,
+        recommendations: dict[Key, str],
+        client_msgs: dict,
+        worker_msgs: dict,
+        stimulus_id: str,
+    ) -> None:
+        """One recommendation round: the native engine when attached
+        and eligible (scheduler/native_engine.py — escapes per key back
+        to the oracle), else the pure-python drain.  Both paths produce
+        bit-identical state, stories and message multisets; the oracle
+        stays selectable at runtime (scheduler.native-engine.enabled,
+        DTPU_NATIVE_DISABLE)."""
+        ne = self.native
+        if ne is not None and ne.active():
+            ne.drive_recs_round(
+                recommendations, stimulus_id, client_msgs, worker_msgs
+            )
+        else:
+            self._transitions(
+                dict(recommendations), client_msgs, worker_msgs, stimulus_id
+            )
+
     def transitions(self, recommendations: dict[Key, str], stimulus_id: str) -> tuple[dict, dict]:
         """Public entry: process recommendations, return (client_msgs, worker_msgs)."""
         tr = self.trace
@@ -809,7 +872,9 @@ class SchedulerState:
         t0 = self.clock()
         self.wall.push("engine.drain", stimulus_id)
         try:
-            self._transitions(recommendations, client_msgs, worker_msgs, stimulus_id)
+            self._drain_round(
+                recommendations, client_msgs, worker_msgs, stimulus_id
+            )
         finally:
             self.wall.pop()
         # histograms observe regardless of trace.enabled: dtpu_engine_*
@@ -1599,12 +1664,18 @@ class SchedulerState:
             cs.wants_what.discard(ts)
         ts.who_wants.clear()
         self.tasks.pop(ts.key, None)
+        if self.native is not None:
+            self.native.on_forget_task(ts)
 
     def _exit_processing_common(self, ts: TaskState) -> None:
         """Remove from processing_on worker and fix occupancy
         (reference _exit_processing_common scheduler.py:3264)."""
         ws = ts.processing_on
         assert ws is not None
+        # stealing's confirm path calls this OUTSIDE a _transition, so
+        # the SoA mark cannot ride the _transition funnel
+        if self.native is not None:
+            self.native.mark_task(ts)
         ts.processing_on = None
         ts.homed = False
         duration = ws.processing.pop(ts, 0.0)
@@ -1662,6 +1733,11 @@ class SchedulerState:
                     0, 0, duration, "", "",
                     supersede=ts.ledger_row,
                 )
+        # stealing's re-placement calls this OUTSIDE a _transition (see
+        # _exit_processing_common); the mark must not depend on the
+        # _transition funnel
+        if self.native is not None:
+            self.native.mark_task(ts)
         ws.processing[ts] = duration + comm
         ts.processing_on = ws
         ts.state = "processing"
@@ -2089,6 +2165,8 @@ class SchedulerState:
         # the caller already made)
         if self.mirror is not None:
             self.mirror.mark(ws)
+        if self.native is not None:
+            self.native.mark_worker(ws)
         if self.total_nthreads == 0 or ws.status == WORKER_STATUS_CLOSED:
             return
         if occ is None:
@@ -2123,6 +2201,8 @@ class SchedulerState:
         self._total_occupancy = max(0.0, self._total_occupancy + delta)
         if self.mirror is not None:
             self.mirror.mark(ws)
+        if self.native is not None:
+            self.native.mark_worker(ws)
 
     def _task_slots_available(self, ws: WorkerState) -> int:
         """Open slots below the saturation threshold (reference scheduler.py:8762)."""
@@ -2262,6 +2342,8 @@ class SchedulerState:
             self.replicated_tasks.add(ts)
         if self.mirror is not None:
             self.mirror.mark(ws)
+        if self.native is not None:
+            self.native.on_replica(ts, ws, True)
 
     def remove_replica(self, ts: TaskState, ws: WorkerState) -> None:
         ws.nbytes -= ts.get_nbytes()
@@ -2271,15 +2353,21 @@ class SchedulerState:
             self.replicated_tasks.discard(ts)
         if self.mirror is not None:
             self.mirror.mark(ws)
+        if self.native is not None:
+            self.native.on_replica(ts, ws, False)
 
     def remove_all_replicas(self, ts: TaskState) -> None:
         nbytes = ts.get_nbytes()
         mirror = self.mirror
+        if self.native is not None:
+            self.native.mark_task(ts)
         for ws in ts.who_has:
             ws.nbytes -= nbytes
             del ws.has_what[ts]
             if mirror is not None:
                 mirror.mark(ws)
+            if self.native is not None:
+                self.native.mark_worker(ws)
         if len(ts.who_has) > 1:
             self.replicated_tasks.discard(ts)
         ts.who_has.clear()
@@ -2292,6 +2380,10 @@ class SchedulerState:
         if ts.prefix is not None:
             ts.prefix.nbytes_total += diff
         mirror = self.mirror
+        native = self.native
+        if native is not None:
+            # incremental: the SoA applies the same holder-nbytes diffs
+            native.on_nbytes(ts, nbytes)
         for ws in ts.who_has:
             ws.nbytes += diff
             if mirror is not None:
@@ -2462,8 +2554,8 @@ class SchedulerState:
             # already applied to state
             self.wall.push("engine.drain", stimulus_id)
             try:
-                self._transitions(
-                    dict(recommendations), client_msgs, worker_msgs, stimulus_id
+                self._drain_round(
+                    recommendations, client_msgs, worker_msgs, stimulus_id
                 )
             except Exception:
                 logger.exception(
@@ -2492,10 +2584,20 @@ class SchedulerState:
         per-key calls — including per-key ``story`` entries, which keep
         their own per-event stimulus_id for causal tracing.
         """
-        client_msgs: dict = {}
-        worker_msgs: dict = {}
         if not isinstance(finishes, (list, tuple)):
             finishes = list(finishes)
+        ne = self.native
+        if ne is not None and ne.active():
+            # the native drain owns the whole flood: same journal
+            # records, wall phases, histogram/trace observations, and
+            # bit-identical outputs (per-key oracle escapes included).
+            # None = flood below the amortization floor (min-flood):
+            # fall through to the oracle below.
+            out = ne.drive_finished_flood(finishes)
+            if out is not None:
+                return out
+        client_msgs = {}
+        worker_msgs = {}
         tr = self.trace
         t0 = self.clock()
         self.wall.push("engine.drain", finishes[0][2] if finishes else "")
@@ -2743,6 +2845,8 @@ class SchedulerState:
             # graft-lint: allow[mirror-parity] row marked by the _adjust_occupancy above and the check_idle_saturated below
             ws.processing[ts] = 0.0
         ws.long_running.add(ts)
+        if self.native is not None:
+            self.native.mark_task(ts)
         self.check_idle_saturated(ws)
         return {}, {}
 
@@ -2836,6 +2940,8 @@ class SchedulerState:
         self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         if self.mirror is not None:
             self.mirror.on_add_worker(ws)
+        if self.native is not None:
+            self.native.on_add_worker(ws)
         self.check_idle_saturated(ws)
         if self.placement is not None:
             self.placement.on_add_worker(self, ws)
@@ -2852,6 +2958,8 @@ class SchedulerState:
             ws.status_seq = status_seq
         if self.mirror is not None:
             self.mirror.mark(ws)
+        if self.native is not None:
+            self.native.mark_worker(ws)
 
     def set_worker_nthreads(self, ws: WorkerState, nthreads: int) -> None:
         """Mirror-aware worker resize.  No production message resizes a
@@ -2860,6 +2968,8 @@ class SchedulerState:
         so the mirror's resize delta path stays proven."""
         self.total_nthreads += nthreads - ws.nthreads
         ws.nthreads = nthreads
+        if self.native is not None:
+            self.native.mark_worker(ws)
         self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         self.check_idle_saturated(ws)
 
@@ -2919,6 +3029,8 @@ class SchedulerState:
             self.resources[r].pop(address, None)
         if self.mirror is not None:
             self.mirror.on_remove_worker(ws)
+        if self.native is not None:
+            self.native.on_remove_worker(ws)
         if self.placement is not None:
             self.placement.on_remove_worker(self, ws)
         # tasks parked for the dead worker become globally poppable again
@@ -2987,6 +3099,8 @@ class SchedulerState:
                 ts = self.new_task(key, None, "released")
             ts.who_wants.add(cs)
             cs.wants_what.add(ts)
+            if self.native is not None:
+                self.native.on_who_wants(ts)
 
     def client_releases_keys(
         self, keys: Iterable[Key], client: str, stimulus_id: str
@@ -3002,6 +3116,8 @@ class SchedulerState:
                 continue
             cs.wants_what.discard(ts)
             ts.who_wants.discard(cs)
+            if self.native is not None:
+                self.native.on_who_wants(ts)
             if not ts.who_wants:
                 if not ts.dependents:
                     recommendations[key] = "forgotten"
@@ -3085,6 +3201,7 @@ class SchedulerState:
                 computation.groups.add(ts.group)
             touched.append(ts)
 
+        native = self.native
         for key, deps in dependencies.items():
             ts = self.tasks[key]
             for dkey in deps:
@@ -3092,6 +3209,10 @@ class SchedulerState:
                 if dts is None:
                     dts = self.new_task(dkey, None, "released")
                 ts.add_dependency(dts)
+                if native is not None:
+                    native.mark_task(dts)
+            if native is not None:
+                native.mark_task(ts)
 
         for ts in touched:
             key = ts.key
@@ -3145,6 +3266,8 @@ class SchedulerState:
                         ts.priority = new_pri
             if (actors is True) or (isinstance(actors, list) and key in actors):
                 ts.actor = True
+            if native is not None:
+                native.mark_task(ts)
 
         # fill priorities for tasks created only as dependencies
         for ts in self.tasks.values():

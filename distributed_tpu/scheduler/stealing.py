@@ -130,6 +130,16 @@ class WorkStealing:
     def remove_worker(self, scheduler: Any, address: str) -> None:
         self.stealable.pop(address, None)
 
+    # Tape-safe plugin contract (scheduler/native_engine.py): the
+    # native engine's applier replays ``transition`` per tape row in
+    # exact stream order with task/scheduler state current as of that
+    # row.  This hook qualifies because it reads only its arguments,
+    # row-current task state and stealing-private structures — it must
+    # never read WorkerState.occupancy (native floods sync occupancy at
+    # segment end, not per row).  Any plugin WITHOUT this marker forces
+    # the whole flood onto the pure-python oracle.
+    tape_safe = True
+
     def transition(self, key: Key, start: str, finish: str, *args: Any,
                    **kwargs: Any) -> None:
         """Track stealability as tasks enter/leave processing."""

@@ -233,6 +233,8 @@ class ShuffleSchedulerExtension:
             ts.exception = exc
             ts.exception_text = str(exc)
             ts.exception_blame = ts
+            if state.native is not None:  # blame flag lives in the SoA
+                state.native.mark_task(ts)
             recs[k] = "erred"
         if recs:
             stimulus_id = seq_name("shuffle-failed")
@@ -262,6 +264,8 @@ class ShuffleSchedulerExtension:
             ts = state.tasks.get(f"{st.id}-unpack-{j}")
             if ts is not None:
                 ts.worker_restrictions = {addr}
+                if state.native is not None:  # restriction flag -> SoA
+                    state.native.mark_task(ts)
         # release the whole pipeline for recomputation under the new epoch
         recs = {
             k: "released"

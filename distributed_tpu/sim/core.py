@@ -320,6 +320,7 @@ class ClusterSim:
         use_device_kernels: bool = False,
         config_overrides: dict[str, Any] | None = None,
         ledger_size: int | None = None,
+        native: bool | None = None,
     ):
         self.clock = VirtualClock()
         self.heap = EventHeap()
@@ -345,6 +346,15 @@ class ClusterSim:
             self._overrides["scheduler.jax.enabled"] = False
         if ledger_size is not None:
             self._overrides["scheduler.ledger.size"] = int(ledger_size)
+        # native transition engine (scheduler/native_engine.py): None =
+        # the config default (attach if the library is already built);
+        # False = force the pure-python oracle (the A/B baseline arm);
+        # True = attach, compiling on demand.  Same-seed digests are
+        # bit-identical EITHER way — that is the engine's contract and
+        # the sim parity tests' subject.
+        self.native = native
+        if native is False:
+            self._overrides["scheduler.native-engine.enabled"] = False
         self._overrides.update(config_overrides or {})
 
         # deterministic per-run stimulus-id mint (seq_name is a
@@ -375,6 +385,8 @@ class ClusterSim:
                 mirror=None if self.use_device_kernels else False,
                 clock=self.clock,
             )
+            if native is True and not self.validate:
+                self.state.attach_native(build=True)
             # decision-ledger digest (ledger.py): opt-in live (a blake2b
             # fold per join), always on under the virtual clock — the
             # same-seed bit-identical-ledger contract costs nothing a
@@ -1004,6 +1016,13 @@ class TransitionDigest:
     ``(key, start, finish, stimulus_id)`` into a running blake2b as it
     happens — the transition_log is a bounded deque, so a whole-run
     digest cannot be taken from it after the fact."""
+
+    # consumes only (key, start, finish, stimulus_id) — the native
+    # engine's tape carries exactly those, so this plugin may stay
+    # installed while floods run natively (native_engine.py replays
+    # plugin.transition per tape row in stream order); any plugin
+    # WITHOUT this marker forces the pure-python oracle
+    tape_safe = True
 
     def __init__(self):
         self._h = hashlib.blake2b(digest_size=16)

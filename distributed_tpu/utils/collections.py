@@ -167,6 +167,138 @@ class HeapSet(Generic[T]):
         self._token.clear()
 
 
+class OrderedSet(dict):
+    """A set with deterministic, insertion-ordered iteration.
+
+    The scheduler's task relation fields (``dependencies`` /
+    ``dependents`` / ``waiters`` / ``waiting_on`` / ``who_has``) use
+    this instead of ``set``: the transition engine's recommendation
+    order — and therefore steal/placement tie-breaks, message emission
+    order and the simulator's event order — derive from iterating these
+    collections, and built-in ``set`` iteration order depends on
+    ``PYTHONHASHSEED``.  Insertion order makes the whole control plane
+    deterministic ACROSS processes (the sim's same-seed contract was
+    previously per-process only) and is what lets the native engine
+    (``scheduler/native_engine.py``) mirror the exact order in plain
+    C++ vectors.
+
+    Implemented as a ``dict`` subclass mapping every element to None so
+    membership, iteration, and len run at C speed on the engine hot
+    path (a wrapper object cost ~1µs/op there).  Semantics match dict
+    keys: re-adding a present element keeps its position; discard
+    preserves the order of the rest; removing then re-adding appends at
+    the end.  NOTE ``pop`` is dict.pop (by element), not set.pop.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, items: "Iterator[T] | None" = None):
+        super().__init__()
+        if items is not None:
+            for el in items:
+                dict.__setitem__(self, el, None)
+
+    def add(self, el: T) -> None:
+        dict.__setitem__(self, el, None)
+
+    def discard(self, el: T) -> None:
+        dict.pop(self, el, None)
+
+    def remove(self, el: T) -> None:
+        dict.__delitem__(self, el)
+
+    def update(self, items: "Iterator[T]") -> None:  # type: ignore[override]
+        for el in items:
+            dict.__setitem__(self, el, None)
+
+    def copy(self) -> "OrderedSet[T]":  # type: ignore[override]
+        return OrderedSet(self)
+
+    def difference(self, *others: Any) -> "OrderedSet[T]":
+        out = OrderedSet(self)
+        for other in others:
+            for el in other:
+                dict.pop(out, el, None)
+        return out
+
+    def intersection(self, *others: Any) -> "OrderedSet[T]":
+        return OrderedSet(
+            el for el in self if all(el in other for other in others)
+        )
+
+    def union(self, *others: Any) -> "OrderedSet[T]":
+        out = OrderedSet(self)
+        for other in others:
+            out.update(other)
+        return out
+
+    def isdisjoint(self, other: Any) -> bool:
+        return all(el not in self for el in other)
+
+    # binary ops interoperate with plain sets in either position; the
+    # ordered operand keeps its order where one is involved (__rand__
+    # returns an OrderedSet too), except __rsub__/__ror__ where the
+    # plain-set left operand's type wins
+    def __and__(self, other: Any) -> "OrderedSet[T]":
+        return OrderedSet(el for el in self if el in other)
+
+    __rand__ = __and__
+
+    def __or__(self, other: Any) -> "OrderedSet[T]":  # type: ignore[override]
+        return self.union(other)
+
+    def __sub__(self, other: Any) -> "OrderedSet[T]":
+        return self.difference(other)
+
+    def __ior__(self, other: Any) -> "OrderedSet[T]":
+        # inherited dict.__ior__ expects key/value pairs and raises on
+        # a plain set — in-place union must mean set semantics here
+        self.update(other)
+        return self
+
+    def __le__(self, other: Any) -> bool:
+        return all(el in other for el in self)
+
+    def __lt__(self, other: Any) -> bool:
+        return len(self) < len(other) and self.__le__(other)
+
+    def __ge__(self, other: Any) -> bool:
+        return all(el in self for el in other)
+
+    def __gt__(self, other: Any) -> bool:
+        return len(self) > len(other) and self.__ge__(other)
+
+    issubset = __le__
+    issuperset = __ge__
+
+    def __rsub__(self, other: Any) -> set:
+        return {el for el in other if el not in self}
+
+    def __ror__(self, other: Any) -> set:  # type: ignore[override]
+        out = set(other)
+        out.update(self)
+        return out
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, OrderedSet):
+            return dict.__eq__(self, other)
+        if isinstance(other, (set, frozenset)):
+            return self.keys() == other
+        return NotImplemented
+
+    def __ne__(self, other: object) -> bool:
+        # dict.__ne__ vs a plain set returns NotImplemented and falls
+        # back to identity, so `ordered != plain` would be True even
+        # when `ordered == plain` — delegate explicitly
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    __hash__ = None  # type: ignore[assignment]
+
+    def __repr__(self) -> str:
+        return f"OrderedSet({list(self)!r})"
+
+
 class LRU(OrderedDict):
     """Dict with a maximum size, evicting the least recently *set* item."""
 

@@ -1095,7 +1095,17 @@ class WorkerState:
             self.data_needed[w].add(ts)
         return {}, []
 
-    def _transition_released_memory(self, ts, *, stimulus_id):
+    def _transition_released_memory(self, ts, *, stimulus_id, payload=None):
+        # ``payload`` arrives when an in-flight execute completes for a
+        # task that went released (not cancelled-parked) in the
+        # meantime: _handle_execute_success already stored the value
+        # and nbytes, so keeping the replica and announcing it via
+        # add-keys is the right outcome — the scheduler either wants it
+        # or answers remove-replicas.  Without the parameter this arm
+        # raised TypeError and killed the whole stimulus batch
+        # (PYTHONHASHSEED-dependent crash found by the partition chaos
+        # scenario; pre-existing — reproduced on the parent commit at
+        # seeds 5 and 11).
         return self._put_memory(ts, stimulus_id, send_add_keys=True)
 
     def _transition_released_forgotten(self, ts, *, stimulus_id):

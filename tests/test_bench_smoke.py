@@ -58,6 +58,17 @@ def test_bench_smoke_runs_and_reports():
     assert mesh["identity_1x1"] is True
     assert mesh["agreement"] > 0.97
     assert mesh["n_workers"] > 0
+    # native transition engine (native/engine.cpp; docs/native_engine.md):
+    # randomized-flood bit-parity vs the python oracle, the compiled
+    # arms absorbing their share (escape rate < 10%), a same-session
+    # speedup over the 1.3x floor, and the per-flood alloc budget
+    # (the bench half raises on any violation; these pin the contract)
+    engine = out["configs"]["engine"]
+    assert engine["parity"] is True
+    assert engine["native_transitions"] > 0
+    assert engine["escape_rate"] < 0.10
+    assert engine["speedup_best"] >= 1.3
+    assert engine["alloc_delta_blocks"] < 300
     assert len(mesh["engine_shards"]) >= 2
     assert all(r["h2d_bytes"] > 0 for r in mesh["engine_shards"])
     ms = mesh["mirror_shards"]

@@ -1106,6 +1106,11 @@ def test_metrics_names_unique_and_documented():
         [{"shard": 0, "kernel_ms": 0.5, "h2d_bytes": 1024},
          {"shard": 1, "kernel_ms": 0.6, "h2d_bytes": 1024}]
     )
+    # seed the native transition engine (scheduler/native_engine.py) so
+    # the dtpu_engine_native_* families are exercised where the
+    # toolchain exists; a no-g++ box skips them (graceful fallback is
+    # the contract, and the names stay documented either way)
+    _Sched.state.attach_native(build=True)
 
     class _SpillDict(dict):  # enables the spill metric lines
         spilled_count = 0
@@ -1202,6 +1207,10 @@ def test_metrics_names_unique_and_documented():
             "dtpu_loop_lag_seconds_count",
             "dtpu_loop_ticks_total",
             "dtpu_loop_stalls_total"} <= all_names
+    if _Sched.state.native is not None:
+        assert {"dtpu_engine_native_transitions_total",
+                "dtpu_engine_native_escapes_total",
+                "dtpu_engine_native_oracle_transitions_total"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
         f"metrics missing from the docs/observability.md table: "
