@@ -199,6 +199,21 @@ defaults: dict[str, Any] = {
             "interval": "2s",
             "policies": [{"class": "distributed_tpu.scheduler.amm.ReduceReplicas"}],
         },
+        # scheduler durability (scheduler/durability.py;
+        # docs/durability.md): periodic incremental SchedulerState
+        # snapshots + an append-only journal-segment tail, so a
+        # scheduler bounce restarts from snapshot + tail replay instead
+        # of total state loss.  Off unless ``directory`` is set.
+        "durability": {
+            "directory": None,          # durable sink dir; None = off
+            "snapshot-interval": "5s",  # incremental snapshot cadence
+            "flush-interval": "1s",     # journal segment flush cadence
+            "full-every": 16,           # base snapshot every N epochs
+            # bounded re-registration window after a restore: workers
+            # from the snapshot that have not re-registered when it
+            # expires are removed and their tasks rescheduled
+            "grace": "15s",
+        },
     },
     "worker": {
         "blocked-handlers": [],
@@ -225,6 +240,19 @@ defaults: dict[str, Any] = {
         "execute-pipeline": 16,
         "execute-pipeline-threshold": "5ms",
         "connections": {"outgoing": 50, "incoming": 10},
+        # registration handshake retry/backoff (worker/server.py): a
+        # register-worker RPC that times out retries with exponential
+        # backoff + seeded jitter; the scheduler side is idempotent per
+        # server_id, so a retry after a half-applied registration never
+        # double-counts replicas or occupancy
+        "register": {"retries": 3, "base-delay": "100ms", "max-delay": "2s"},
+        # scheduler-stream reconnect (scheduler bounce survival): when
+        # > 0, a worker whose scheduler stream dies re-registers with
+        # backoff for up to this many attempts — carrying its held data
+        # keys so the restarted scheduler's recovery window can rebuild
+        # who_has — instead of closing.  0 keeps the historical
+        # behavior: stream loss closes the worker (nanny restarts it).
+        "reconnect-attempts": 0,
         "preload": [],
         "preload-argv": [],
         "validate": False,

@@ -363,6 +363,16 @@ def replay_stimulus_trace(state: Any, records: Iterable[dict],
                     dict(payload.get("kwargs") or {}),
                 )
             )
+        elif op == "tasks-finished-batch":
+            # one record per engine flood (the batch arm's format —
+            # per-event records cost more than the engine's own
+            # per-event work on the durability capture path); replays
+            # with the exact live flood boundary
+            flush()
+            merge(*state.stimulus_tasks_finished_batch([
+                (k, w, sid, dict(kw))
+                for k, w, sid, kw in payload.get("finishes") or ()
+            ]))
         elif op == "release-worker-data":
             # replica removal only: the mutation happens OUTSIDE the
             # engine, and the engine round it recommended (if any) was
@@ -408,6 +418,125 @@ def replay_stimulus_trace(state: Any, records: Iterable[dict],
                 payload.get("worker", ""), stimulus_id=rec.get("stim", ""),
                 safe=bool(payload.get("safe", False)),
             ))
+        elif op == "add-worker":
+            flush()
+            state.add_worker_state(
+                payload.get("address", ""),
+                nthreads=int(payload.get("nthreads") or 1),
+                memory_limit=int(payload.get("memory_limit") or 0),
+                name=payload.get("name"),
+                resources=payload.get("resources") or None,
+                server_id=payload.get("server_id"),
+            )
+        elif op == "update-graph":
+            # graph intake: priorities were resolved at record time and
+            # per-task dependency lists carry the original iteration
+            # order, so the materialized TaskStates (relation-set
+            # insertion order included) are bit-identical.  The record
+            # replays its own engine round (update_graph_core runs
+            # _transitions_observed), so no nested "transitions" record
+            # exists for it.
+            flush()
+            from distributed_tpu.scheduler.durability import decode_run_spec
+
+            retries = payload.get("retries")
+            actors = payload.get("actors") or False
+            merge(*state.update_graph_core(
+                {
+                    k: decode_run_spec(v)
+                    for k, v in (payload.get("tasks") or {}).items()
+                },
+                {
+                    k: list(v)
+                    for k, v in (payload.get("dependencies") or {}).items()
+                },
+                list(payload.get("keys") or ()),
+                client=payload.get("client"),
+                priorities={
+                    k: tuple(v)
+                    for k, v in (payload.get("priorities") or {}).items()
+                },
+                user_priority=payload.get("user_priority") or 0,
+                generation=int(payload.get("generation") or 0),
+                annotations_by_key=payload.get("annotations_by_key"),
+                retries=retries,
+                actors=actors,
+            ))
+        elif op == "client-desires-keys":
+            flush()
+            state.client_desires_keys(
+                payload.get("keys") or (), payload.get("client", "")
+            )
+        elif op == "client-releases-keys":
+            flush()
+            merge(*state.client_releases_keys(
+                payload.get("keys") or (), payload.get("client", ""),
+                rec.get("stim", ""),
+            ))
+        elif op == "scatter-data":
+            flush()
+            merge(*state.stimulus_scatter_data(
+                payload.get("key", ""), list(payload.get("workers") or ()),
+                int(payload.get("nbytes") or 0), payload.get("client"),
+                rec.get("stim", ""),
+            ))
+        elif op == "worker-status-change":
+            flush()
+            merge(*state.stimulus_worker_status_change(
+                payload.get("worker", ""), payload.get("status", ""),
+                int(payload.get("status_seq", -1)), rec.get("stim", ""),
+            ))
+        elif op == "steal-move":
+            flush()
+            merge(*state.stimulus_steal_move(
+                payload.get("key", ""), payload.get("victim", ""),
+                payload.get("thief", ""), rec.get("stim", ""),
+                kind=payload.get("kind", "steal"),
+            ))
+        elif op == "steal-request":
+            # the confirm window opened by move_task_request: rebuild
+            # the stealing extension's in_flight entry (with its exact
+            # priced durations and occupancy overlays) so a
+            # steal-response answered after a restart finds it — the
+            # restart-during-in-flight-steal case.  A state without the
+            # extension (bare replay harness) skips: the entry is
+            # extension truth, not engine truth.
+            flush()
+            steal = (state.extensions or {}).get("stealing")
+            ts = state.tasks.get(payload.get("key", ""))
+            victim = state.workers.get(payload.get("victim", ""))
+            thief = state.workers.get(payload.get("thief", ""))
+            if (steal is not None and ts is not None
+                    and victim is not None and thief is not None
+                    and ts.key not in steal.in_flight):
+                steal.remove_key_from_stealable(ts)
+                steal.seed_in_flight(
+                    ts, victim, thief,
+                    float(payload.get("vd") or 0.0),
+                    float(payload.get("td") or 0.0),
+                    rec.get("stim", ""),
+                )
+        elif op == "steal-rr":
+            # balance-cycle round-robin cursor pin (stealing.balance)
+            flush()
+            steal = (state.extensions or {}).get("stealing")
+            if steal is not None:
+                steal._rr = int(payload.get("rr") or 0)
+        elif op == "steal-confirm":
+            # the close of a confirm window (move_task_confirm's pop);
+            # the engine-side move — if the victim yielded — replays
+            # from its own following "steal-move" record
+            flush()
+            steal = (state.extensions or {}).get("stealing")
+            if steal is not None:
+                info = steal.in_flight.pop(payload.get("key", ""), None)
+                if info is not None and payload.get("matched"):
+                    steal.in_flight_occupancy[info.thief] -= info.thief_duration
+                    steal.in_flight_occupancy[info.victim] += info.victim_duration
+                    steal.in_flight_tasks[info.victim] -= 1
+                    if not steal.in_flight:
+                        steal.in_flight_occupancy.clear()
+                        steal._in_flight_event.set()
         elif op == "transitions":
             flush()
             merge(

@@ -677,6 +677,52 @@ def scheduler_metrics(scheduler: Any) -> bytes:
                 help_="Confirmed task steals", type_="counter",
             )
         )
+    # scheduler durability (scheduler/durability.py; docs/durability.md):
+    # snapshot/segment capture economics + the measured recovery (RTO)
+    # of the last restore — absent entirely when durability is off
+    dur = getattr(scheduler, "durability", None)
+    if dur is not None:
+        st = dur.stats
+        for name, val, help_, type_ in (
+            ("dtpu_durability_snapshot_seconds_total", st.snapshot_seconds,
+             "Wall seconds encoding snapshots (on-loop half)", "counter"),
+            ("dtpu_durability_snapshot_bytes_total", st.snapshot_bytes,
+             "Snapshot bytes handed to the durable sink", "counter"),
+            ("dtpu_durability_snapshot_rows_total", st.snapshot_rows,
+             "Task rows serialized across snapshots (delta-encoded: "
+             "O(changed) per epoch)", "counter"),
+            ("dtpu_durability_epochs_total", st.epochs,
+             "Snapshot epochs written", "counter"),
+            ("dtpu_durability_base_epochs_total", st.base_epochs,
+             "Full (base) snapshot epochs written", "counter"),
+            ("dtpu_durability_journal_records_total", st.journal_records,
+             "Stimulus records captured into journal segments", "counter"),
+            ("dtpu_durability_journal_bytes_total", st.journal_bytes,
+             "Journal segment bytes handed to the durable sink",
+             "counter"),
+            ("dtpu_durability_replay_records", st.replay_records,
+             "Journal-tail records replayed by the last restore",
+             "gauge"),
+            ("dtpu_durability_restore_seconds", st.restore_seconds,
+             "Measured RTO of the last restore (load + rebuild + "
+             "digest check + tail replay)", "gauge"),
+            ("dtpu_durability_torn_records_total", st.torn_records,
+             "Torn final journal records dropped at restore", "counter"),
+            ("dtpu_durability_reconcile_corrections_total",
+             st.reconcile_corrections,
+             "who_has corrections applied by worker re-registration "
+             "reconciliation", "counter"),
+        ):
+            lines.append(prom_line(name, val, help_=help_, type_=type_))
+        rec = getattr(scheduler, "_recovery", None)
+        lines.append(
+            prom_line(
+                "dtpu_durability_recovery_awaiting_workers",
+                len(rec["awaiting"]) if rec else 0,
+                help_="Restored workers still inside the re-registration "
+                      "grace window", type_="gauge",
+            )
+        )
     mirror = getattr(s, "mirror", None)
     if mirror is not None:
         # fleet-mirror health (scheduler/mirror.py): a production

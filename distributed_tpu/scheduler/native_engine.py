@@ -552,17 +552,19 @@ class NativeEngine:
         self.floods += 1
         s.wall.push("engine.drain", stim0)
         try:
-            if tr.journal_enabled:
-                # journal records are the engine's INPUTS: a pre-pass
-                # writes the identical record stream the oracle's
-                # interleaved appends would
-                for key, worker, sid, kwargs in finishes:
-                    tr.record(
-                        "task-finished",
-                        {"key": key, "worker": worker,
-                         "kwargs": dict(kwargs)},
-                        sid,
-                    )
+            if tr.journal_enabled and finishes:
+                # journal records are the engine's INPUTS: the same
+                # single per-flood record the oracle arm writes (same
+                # empty-flood guard) — streams stay bit-identical
+                # across engines
+                tr.record(
+                    "tasks-finished-batch",
+                    {"finishes": [
+                        [key, worker, sid, dict(kwargs)]
+                        for key, worker, sid, kwargs in finishes
+                    ]},
+                    stim0,
+                )
             i, n = 0, len(finishes)
             while i < n:
                 if s.queued or not self.active():
@@ -843,6 +845,7 @@ class NativeEngine:
             tr = s.trace
             tr_enabled = tr.enabled
             plugins = list(s.plugins.values()) if s.plugins else None
+            dtrack = s.durability
             led = s.ledger
             led_on = led.enabled
             log = s.transition_log.append
@@ -926,6 +929,12 @@ class NativeEngine:
                                     "Plugin %r failed in transition",
                                     plugin,
                                 )
+                    if dtrack is not None:
+                        # the worker's processing mirror mutated inline
+                        # above (not through a marking helper): its
+                        # order lists must ride the next delta snapshot
+                        dtrack.mark_transition(ts)
+                        dtrack.mark_worker(ws)
                 elif op == OP_PM:
                     ts = rows[t_a[j]]
                     ws = wslots[t_b[j]]
@@ -1034,6 +1043,11 @@ class NativeEngine:
                                     "Plugin %r failed in transition",
                                     plugin,
                                 )
+                    if dtrack is not None:
+                        # has_what/processing mutated inline (the
+                        # add_replica/_exit_processing twins above)
+                        dtrack.mark_transition(ts)
+                        dtrack.mark_worker(ws)
                 elif op == OP_MR:
                     ts = rows[t_a[j]]
                     key = ts.key
@@ -1092,6 +1106,8 @@ class NativeEngine:
                                     "Plugin %r failed in transition",
                                     plugin,
                                 )
+                    if dtrack is not None:
+                        dtrack.mark_transition(ts)
                 elif op == OP_RW:
                     ts = rows[t_a[j]]
                     key = ts.key
@@ -1129,6 +1145,8 @@ class NativeEngine:
                                     "Plugin %r failed in transition",
                                     plugin,
                                 )
+                    if dtrack is not None:
+                        dtrack.mark_transition(ts)
                 elif op == OP_FLIP:
                     ws = wslots[t_a[j]]
                     which = t_b[j]

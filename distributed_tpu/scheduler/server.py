@@ -31,7 +31,7 @@ from distributed_tpu.rpc.core import (
     Status,
     error_message,
 )
-from distributed_tpu.scheduler.state import SchedulerState, WorkerState, _merge_msgs
+from distributed_tpu.scheduler.state import SchedulerState, WorkerState
 from distributed_tpu.utils.comm import gather_from_workers, scatter_to_workers
 from distributed_tpu.utils.misc import seq_name, time
 
@@ -51,6 +51,69 @@ def default_extensions() -> dict[str, Any]:
         "shuffle": ShuffleSchedulerExtension,
         **coordination_extensions(),
     }
+
+
+class _ThreadedSink:
+    """Durability sink wrapper that runs every write on ONE executor
+    thread: the event loop encodes snapshot/journal bytes and returns
+    immediately; the fsync'd file IO (durability.FileSink) happens
+    off-loop, in submission order — so a crash loses only a suffix of
+    the write sequence, which is exactly the crash model the loader's
+    epoch/watermark contract tolerates.  Reads are start-up-only
+    (restore precedes the first write) and pass straight through."""
+
+    def __init__(self, inner: Any):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.inner = inner
+        # stats to bill journal bytes to, set after the manager exists:
+        # segment serialization (digest stamping included) happens on
+        # the writer thread, so the byte count is only known there
+        self.stats: Any | None = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dtpu-durability"
+        )
+
+    def _submit(self, fn: Any, *args: Any) -> None:
+        def run() -> None:
+            try:
+                fn(*args)
+            except Exception:
+                logger.exception("durability sink write failed")
+
+        self._pool.submit(run)
+
+    def write_snapshot(self, epoch: int, blob: bytes) -> int:
+        self._submit(self.inner.write_snapshot, epoch, blob)
+        return len(blob)
+
+    def append_journal(self, epoch: int, records: list) -> int:
+        def run() -> None:
+            try:
+                n = self.inner.append_journal(epoch, records)
+                if self.stats is not None:
+                    self.stats.journal_bytes += n
+            except Exception:
+                logger.exception("durability sink write failed")
+
+        self._pool.submit(run)
+        return 0
+
+    def drain(self) -> None:
+        """Block until every queued write hit disk (graceful close)."""
+        self._pool.shutdown(wait=True)
+
+    def read_snapshot(self, epoch: int) -> bytes:
+        return self.inner.read_snapshot(epoch)
+
+    def read_journal(self, epoch: int) -> bytes:
+        return self.inner.read_journal(epoch)
+
+    def snapshot_epochs(self) -> list[int]:
+        return self.inner.snapshot_epochs()
+
+    def journal_epochs(self) -> list[int]:
+        return self.inner.journal_epochs()
 
 
 class Scheduler(Server):
@@ -255,6 +318,16 @@ class Scheduler(Server):
         # catches loop stalls with a traceback
         self.cp_profiler: Any | None = None
         self.watchdog: Any | None = None
+        # scheduler durability (scheduler/durability.py;
+        # docs/durability.md): armed at start_unsafe when
+        # scheduler.durability.directory is set — restore from
+        # snapshot + journal tail, then capture snapshots/segments
+        self.durability: Any | None = None
+        # re-registration window after a restore: restored worker
+        # addresses still expected back, and the absolute (monotonic)
+        # deadline after which the missing ones are removed and their
+        # tasks rescheduled
+        self._recovery: dict | None = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -277,6 +350,10 @@ class Scheduler(Server):
                 loop.call_soon_threadsafe(self.state.attach_native)
 
         native.prebuild_async(on_ready=_native_ready)
+        # durability restore + capture arm BEFORE the listener exists:
+        # nothing can register or submit against a half-restored state
+        if config.get("scheduler.durability.directory"):
+            self._durability_start()
         addr = self._listen_addr or "tcp://127.0.0.1:0"
         listen_args = (
             self.security.get_listen_args("scheduler")
@@ -405,6 +482,24 @@ class Scheduler(Server):
             self.periodic_callbacks["idle-timeout"] = PeriodicCallback(
                 self.check_idle, max(self.idle_timeout / 4, 0.25)
             )
+        if self.durability is not None:
+            snap_iv = config.parse_timedelta(
+                config.get("scheduler.durability.snapshot-interval")
+            )
+            flush_iv = config.parse_timedelta(
+                config.get("scheduler.durability.flush-interval")
+            )
+            self.periodic_callbacks["durability-snapshot"] = PeriodicCallback(
+                self._durability_snapshot, snap_iv
+            )
+            self.periodic_callbacks["durability-flush"] = PeriodicCallback(
+                self._durability_flush, flush_iv
+            )
+            if self._recovery is not None:
+                grace = self._recovery["grace"]
+                self.periodic_callbacks["recovery-grace"] = PeriodicCallback(
+                    self._check_recovery_grace, max(grace / 4, 0.05)
+                )
         self.start_periodic_callbacks()
         logger.info("scheduler listening at %s", self.address)
         return self
@@ -455,7 +550,144 @@ class Scheduler(Server):
             await bs.close(timeout=0.5)
         if self.http_server is not None:
             await self.http_server.stop()
+        if self.durability is not None:
+            # graceful close ends the epoch cleanly: one final snapshot
+            # + segment flush, then drain the write thread so the image
+            # on disk is complete before the process exits
+            try:
+                self.durability.snapshot()
+                self.durability.flush_journal()
+                self.durability.sink.drain()
+            except Exception:
+                logger.exception("final durability snapshot failed")
         await super().close()
+
+    # ----------------------------------------------------------- durability
+
+    def _durability_start(self) -> None:
+        """Restore from the durable image (when one exists) and arm
+        capture: the recovery sequence of docs/durability.md.  Runs
+        synchronously before the listener starts — a worker cannot
+        register, and a client cannot submit, against a half-restored
+        state."""
+        from distributed_tpu.diagnostics.flight_recorder import (
+            replay_stimulus_trace,
+        )
+        from distributed_tpu.scheduler import durability as dur
+
+        directory = config.get("scheduler.durability.directory")
+        sink = dur.FileSink(directory)
+        state = self.state
+        next_epoch = 0
+        restore_info = None
+        t0 = time()
+        if sink.snapshot_epochs():
+            folded, tail, info = dur.DurabilityManager.load(sink)
+            dur.restore_state(state, folded)
+            want = info.get("state_digest")
+            if want:
+                got = dur.state_digest(state)
+                if got != want:
+                    raise dur.SnapshotCorruptError(
+                        f"restored state digest {got} != snapshot's "
+                        f"{want}: refusing to continue from a divergent "
+                        "state"
+                    )
+            # per-worker extension structures the live add_worker path
+            # would have built, then the recorded cross-payload steal
+            # truth (in-flight confirm windows, stealable levels)
+            steal = self.extensions.get("stealing")
+            if steal is not None:
+                for ws in state.workers.values():
+                    if ws.address not in steal.stealable:
+                        steal.add_worker_state(ws)
+                dur.restore_stealing(steal, folded.get("ext") or None)
+            replay_stimulus_trace(state, tail, verify_digests=False)
+            restore_info = info
+            next_epoch = int(info["epoch"]) + 1
+            grace = config.parse_timedelta(
+                config.get("scheduler.durability.grace")
+            )
+            awaiting = {
+                ws.address for ws in state.workers.values()
+            }
+            self._recovery = {
+                "awaiting": awaiting,
+                "deadline": time() + grace,
+                "grace": grace,
+                "restored_workers": len(awaiting),
+            }
+            logger.info(
+                "restored scheduler state from %s: epoch %s (+%s deltas), "
+                "%d tail records, %d tasks, %d workers awaiting "
+                "re-registration (grace %.1fs)",
+                directory, info["epoch"], info["deltas"], len(tail),
+                len(state.tasks), len(awaiting), grace,
+            )
+        tsink = _ThreadedSink(sink)
+        mgr = dur.DurabilityManager(state, tsink)
+        tsink.stats = mgr.stats
+        mgr.epoch = next_epoch
+        mgr.attach()
+        self.durability = mgr
+        if restore_info is not None:
+            st = mgr.stats
+            st.replay_records = int(restore_info["tail_records"])
+            st.torn_records = int(restore_info["torn_records"])
+            st.restore_seconds = time() - t0
+
+    def _durability_snapshot(self) -> None:
+        mgr = self.durability
+        if mgr is None:
+            return
+        # encode on-loop (O(changed rows) between payloads), write
+        # off-loop through the single-thread sink
+        info = mgr.snapshot()
+        self.trace.emit(
+            "durability", "snapshot", f"epoch-{info['epoch']}",
+            n=info["task_rows"], dest="sink",
+        )
+
+    def _durability_flush(self) -> None:
+        if self.durability is not None:
+            self.durability.flush_journal()
+
+    async def _check_recovery_grace(self) -> None:
+        """Bounded re-registration window: when the grace expires,
+        restored workers that never came back are removed through the
+        engine — their tasks reschedule exactly like a live departure."""
+        rec = self._recovery
+        if rec is None:
+            return
+        if not rec["awaiting"]:
+            self._finish_recovery()
+            return
+        if time() < rec["deadline"]:
+            return
+        missing = sorted(rec["awaiting"])
+        logger.warning(
+            "recovery grace expired: removing %d workers that never "
+            "re-registered: %s", len(missing), missing[:5],
+        )
+        for address in missing:
+            if address not in rec["awaiting"]:
+                # re-registered while an earlier removal awaited: the
+                # handshake discarded it — must not strip a live worker
+                continue
+            rec["awaiting"].discard(address)
+            try:
+                await self.remove_worker(address, "recovery-grace-expired")
+            except Exception:
+                logger.exception(
+                    "grace-expiry removal failed for %s", address
+                )
+        self._finish_recovery()
+
+    def _finish_recovery(self) -> None:
+        self._recovery = None
+        pc = self.periodic_callbacks.pop("recovery-grace", None)
+        if pc is not None:
+            pc.stop()
 
     # ------------------------------------------------------------ messaging
 
@@ -586,17 +818,40 @@ class Scheduler(Server):
         """Worker registration handshake; the comm becomes the dual stream
         (reference scheduler.py:4308)."""
         address = kwargs["address"]
-        if address in self.state.workers:
-            await comm.write({"status": "error", "message": "worker already exists"})
-            return Status.dont_reply
-        ws = self.state.add_worker_state(
-            address,
-            nthreads=kwargs.get("nthreads", 1),
-            memory_limit=kwargs.get("memory_limit", 0),
-            name=kwargs.get("name"),
-            resources=kwargs.get("resources"),
-            server_id=kwargs.get("server_id"),
-        )
+        existing = self.state.workers.get(address)
+        reregister = False
+        if existing is not None:
+            server_id = kwargs.get("server_id")
+            stream = self.stream_comms.get(address)
+            if server_id is not None and existing.server_id == server_id:
+                # the SAME worker process registering again: a restored
+                # scheduler's re-registration window, or a retried
+                # handshake whose first reply was lost.  Idempotent by
+                # server_id — the state row is reused, so replicas and
+                # occupancy are never double-counted; only the stream
+                # is replaced.
+                reregister = True
+                if stream is not None:
+                    self.stream_comms.pop(address, None)
+                    stream.abort()
+            elif stream is None or stream.closed():
+                # a NEW process took the address and the old one's
+                # stream is already dead: retire the stale row first
+                await self.remove_worker(address, "superseded-by-new-registration")
+            else:
+                await comm.write({"status": "error", "message": "worker already exists"})
+                return Status.dont_reply
+        if reregister:
+            ws = existing
+        else:
+            ws = self.state.add_worker_state(
+                address,
+                nthreads=kwargs.get("nthreads", 1),
+                memory_limit=kwargs.get("memory_limit", 0),
+                name=kwargs.get("name"),
+                resources=kwargs.get("resources"),
+                server_id=kwargs.get("server_id"),
+            )
         if kwargs.get("versions"):
             ws.extra["versions"] = kwargs["versions"]
         if kwargs.get("jax_devices") is not None:
@@ -631,6 +886,32 @@ class Scheduler(Server):
             for k, v in extra.items():
                 d.setdefault(k, []).extend(v)
         self.send_all(client_msgs, worker_msgs)
+        if self._recovery is not None:
+            self._recovery["awaiting"].discard(address)
+        if kwargs.get("held_keys") is not None:
+            # recovery reconciliation (scheduler/durability.py): the
+            # worker's reported data keys rebuild / cross-check who_has
+            # — every correction routed through the engine.  Idempotent:
+            # a retried registration reports the same keys and the
+            # second pass finds nothing to correct.  An EMPTY list still
+            # reconciles: it strips every stale restored replica this
+            # worker no longer holds.
+            from distributed_tpu.scheduler.durability import reconcile_worker
+
+            (cm3, wm3), counts = reconcile_worker(
+                self.state, address, kwargs["held_keys"],
+                seq_name("reconcile"),
+            )
+            corrections = (
+                counts["added"] + counts["finished"] + counts["stripped"]
+            )
+            if corrections:
+                logger.info(
+                    "reconciled %s on (re)registration: %s", address, counts
+                )
+                if self.durability is not None:
+                    self.durability.stats.reconcile_corrections += corrections
+            self.send_all(cm3, wm3)
         for ext in self.extensions.values():
             cb = getattr(ext, "add_worker", None)
             if cb is not None:
@@ -646,12 +927,17 @@ class Scheduler(Server):
         try:
             await self.handle_stream(comm, extra={"worker": address})
         finally:
-            try:
-                await self.remove_worker(address, "stream-closed")
-            except Exception:
-                # a failed removal must be loud: half-applied reschedules
-                # strand tasks on a dead worker
-                logger.exception("remove_worker failed for %s", address)
+            # remove only while THIS registration still owns the stream:
+            # an idempotent re-registration (same server_id) replaces the
+            # stream and aborts this one — the superseded handler waking
+            # up here must not strip the freshly re-registered worker
+            if self.stream_comms.get(address) is bs:
+                try:
+                    await self.remove_worker(address, "stream-closed")
+                except Exception:
+                    # a failed removal must be loud: half-applied
+                    # reschedules strand tasks on a dead worker
+                    logger.exception("remove_worker failed for %s", address)
         return Status.dont_reply
 
     async def remove_worker(self, address: str, reason: str = "", *,
@@ -1069,45 +1355,13 @@ class Scheduler(Server):
     def handle_worker_status_change(self, status: str = "", worker: str = "",
                                     stimulus_id: str = "",
                                     status_seq: int = -1, **kw: Any) -> None:
-        ws = self.state.workers.get(worker)
-        if ws is None:
-            return
-        if status_seq >= 0 and status_seq < ws.status_seq:
-            # stale stream message ordered behind a fresher flip
-            # (possible after a heartbeat-applied reconciliation)
-            return
-        self.state.set_worker_status(
-            ws, status, status_seq if status_seq >= 0 else None
+        # pure twin on SchedulerState (journals itself for the
+        # durability tail; the sans-io simulator drives it directly)
+        cm, wm = self.state.stimulus_worker_status_change(
+            worker, status, status_seq,
+            stimulus_id or seq_name("worker-status"),
         )
-        ws.status_changed_at = time()
-        if status == "paused":
-            self.state.running.discard(ws)
-            self.state.idle.pop(ws.address, None)
-            self.state.idle_task_count.discard(ws)
-            # home-stacked tasks on a paused worker become stealable
-            # again — nothing else would move them off a stalled home
-            steal = self.state.extensions.get("stealing")
-            for ts in ws.processing:
-                if ts.homed:
-                    ts.homed = False
-                    if steal is not None:
-                        steal.put_key_in_stealable(ts)
-            # a paused home can't pull: return its parked tasks to the
-            # global pop heap and let open slots elsewhere take them
-            if ws.address in self.state.parked:
-                self.state.splice_parked(ws.address)
-                stimulus_id = stimulus_id or seq_name("worker-paused")
-                recs = self.state.stimulus_queue_slots_maybe_opened(stimulus_id)
-                cm, wm = self.state.transitions(recs, stimulus_id)
-                self.send_all(cm, wm)
-        elif status == "running":
-            self.state.running.add(ws)
-            self.state.check_idle_saturated(ws)
-            stimulus_id = stimulus_id or seq_name("worker-unpaused")
-            recs = self.state.bulk_schedule_unrunnable_after_adding_worker(ws)
-            recs.update(self.state.stimulus_queue_slots_maybe_opened(stimulus_id))
-            client_msgs, worker_msgs = self.state.transitions(recs, stimulus_id)
-            self.send_all(client_msgs, worker_msgs)
+        self.send_all(cm, wm)
 
     # ------------------------------------------------------------- data ops
 
@@ -1187,39 +1441,15 @@ class Scheduler(Server):
             if not holders:
                 logger.warning("scatter: all holders of %r left; data lost", key)
                 continue
-            ts = self.state.tasks.get(key)
-            if ts is None:
-                ts = self.state.new_task(key, None, "released")
-            if client is not None:
-                # register the client's interest BEFORE entering memory via
-                # the engine, or the no-waiters/no-wants GC releases the key
-                self.state.client_desires_keys([key], client)
-            if ts.state not in ("released", "memory"):
-                # key collides with a task mid-flight: leave the scheduler
-                # state machine alone (the worker copy is surplus data)
-                logger.warning(
-                    "scatter ignoring key %r already in state %r", key, ts.state
-                )
-                continue
-            if ts.priority is None:
-                ts.priority = (0, 0, 0)
-            if ts.state == "released" and holders:
-                # through the engine so accounting stays consistent and
-                # waiting dependents are recommended onward
-                recs, cmsgs, wmsgs = self.state._transition(
-                    key, "memory", stimulus_id,
-                    worker=holders[0], nbytes=payload_nbytes(data[key]),
-                )
-                cm2, wm2 = self.state.transitions(recs, stimulus_id)
-                self.send_all(_merge_msgs(cmsgs, cm2), _merge_msgs(wmsgs, wm2))
-                extra = holders[1:]
-            else:
-                self.state.update_nbytes(ts, payload_nbytes(data[key]))
-                extra = holders
-            for addr in extra:
-                ws = self.state.workers.get(addr)
-                if ws is not None:
-                    self.state.add_replica(ts, ws)
+            # through the journaled engine twin (the sim drives the same
+            # code): scattered data enters memory from no worker
+            # stimulus, so a durable journal tail without these records
+            # replays a cluster whose root partitions never existed
+            cm, wm = self.state.stimulus_scatter_data(
+                key, holders, payload_nbytes(data[key]), client,
+                stimulus_id,
+            )
+            self.send_all(cm, wm)
         if broadcast:
             await self.replicate(keys=list(who_has), n=len(targets) if broadcast is True else broadcast)
         return list(who_has)

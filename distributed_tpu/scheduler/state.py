@@ -524,8 +524,12 @@ class SchedulerState:
         self.resources: defaultdict[str, dict[str, float]] = defaultdict(dict)
 
         self.idle: dict[str, WorkerState] = {}
-        self.idle_task_count: set[WorkerState] = set()
-        self.saturated: set[WorkerState] = set()
+        # insertion-ordered like the task relation fields: the steal
+        # balancer's victim scan iterates saturated, and restart
+        # recovery (scheduler/durability.py) must rebuild the exact
+        # iteration order — built-in set order is allocation-dependent
+        self.idle_task_count: OrderedSet[WorkerState] = OrderedSet()
+        self.saturated: OrderedSet[WorkerState] = OrderedSet()
         self.running: set[WorkerState] = set()
 
         self.queued: HeapSet[TaskState] = HeapSet(key=lambda ts: ts.priority)
@@ -619,6 +623,14 @@ class SchedulerState:
         if config.get("scheduler.native-engine.enabled") and not self.validate:
             self.attach_native()
         self.extensions: dict[str, Any] = {}
+        # durability dirty-mark tracker (scheduler/durability.py): when
+        # attached, out-of-engine mutations (replica truth, worker
+        # lifecycle, client interest) mark rows here so incremental
+        # snapshots re-serialize O(changed) task rows; per-transition
+        # marks are direct calls from the _transition funnel and from
+        # the native tape replay's transition arms (both engines feed
+        # the same dirty sets).
+        self.durability: Any | None = None
         self.events_subscriber_hook: Callable | None = None
         self.events: defaultdict[str, deque] = defaultdict(
             lambda: deque(maxlen=config.get("scheduler.events-log-length"))
@@ -676,6 +688,8 @@ class SchedulerState:
         self.n_tasks += 1
         if self.native is not None:
             self.native.on_new_task(ts)
+        if self.durability is not None:
+            self.durability.mark_task(ts)
         return ts
 
     def _clear_task_state(self) -> None:
@@ -789,6 +803,11 @@ class SchedulerState:
             # have touched ts and both relation neighborhoods
             if self.native is not None:
                 self.native.mark_transition(ts)
+            # durability dirty mark — direct call, not the plugin seam:
+            # the dispatch machinery costs more than the mark and this
+            # runs per transition on the flood path
+            if self.durability is not None:
+                self.durability.mark_transition(ts)
             if arms:
                 self.wall.pop()
 
@@ -1666,6 +1685,8 @@ class SchedulerState:
         self.tasks.pop(ts.key, None)
         if self.native is not None:
             self.native.on_forget_task(ts)
+        if self.durability is not None:
+            self.durability.on_remove_task(ts)
 
     def _exit_processing_common(self, ts: TaskState) -> None:
         """Remove from processing_on worker and fix occupancy
@@ -1676,6 +1697,8 @@ class SchedulerState:
         # the SoA mark cannot ride the _transition funnel
         if self.native is not None:
             self.native.mark_task(ts)
+        if self.durability is not None:
+            self.durability.mark_replica(ts, ws)
         ts.processing_on = None
         ts.homed = False
         duration = ws.processing.pop(ts, 0.0)
@@ -1738,6 +1761,8 @@ class SchedulerState:
         # _transition funnel
         if self.native is not None:
             self.native.mark_task(ts)
+        if self.durability is not None:
+            self.durability.mark_replica(ts, ws)
         ws.processing[ts] = duration + comm
         ts.processing_on = ws
         ts.state = "processing"
@@ -2344,6 +2369,8 @@ class SchedulerState:
             self.mirror.mark(ws)
         if self.native is not None:
             self.native.on_replica(ts, ws, True)
+        if self.durability is not None:
+            self.durability.mark_replica(ts, ws)
 
     def remove_replica(self, ts: TaskState, ws: WorkerState) -> None:
         ws.nbytes -= ts.get_nbytes()
@@ -2355,6 +2382,8 @@ class SchedulerState:
             self.mirror.mark(ws)
         if self.native is not None:
             self.native.on_replica(ts, ws, False)
+        if self.durability is not None:
+            self.durability.mark_replica(ts, ws)
 
     def remove_all_replicas(self, ts: TaskState) -> None:
         nbytes = ts.get_nbytes()
@@ -2370,6 +2399,10 @@ class SchedulerState:
                 self.native.mark_worker(ws)
         if len(ts.who_has) > 1:
             self.replicated_tasks.discard(ts)
+        if self.durability is not None:
+            self.durability.mark_task(ts)
+            for ws in ts.who_has:
+                self.durability.mark_worker(ws)
         ts.who_has.clear()
 
     def update_nbytes(self, ts: TaskState, nbytes: int) -> None:
@@ -2389,6 +2422,8 @@ class SchedulerState:
             if mirror is not None:
                 mirror.mark(ws)
         ts.nbytes = nbytes
+        if self.durability is not None:
+            self.durability.mark_task(ts)
 
     # ------------------------------------------------------- events
 
@@ -2600,15 +2635,23 @@ class SchedulerState:
         worker_msgs = {}
         tr = self.trace
         t0 = self.clock()
+        if tr.journal_enabled and finishes:
+            # ONE record per flood, not per event: the flood is the
+            # stimulus unit the engine consumes, and per-event records
+            # cost more than the engine's own per-event work on the
+            # steady-state path durability capture must stay under
+            # (kwargs copied now — the loop below pops "metadata")
+            tr.record(
+                "tasks-finished-batch",
+                {"finishes": [
+                    [key, worker, sid, dict(kwargs)]
+                    for key, worker, sid, kwargs in finishes
+                ]},
+                finishes[0][2],
+            )
         self.wall.push("engine.drain", finishes[0][2] if finishes else "")
         try:
             for key, worker, stimulus_id, kwargs in finishes:
-                if tr.journal_enabled:
-                    tr.record(
-                        "task-finished",
-                        {"key": key, "worker": worker, "kwargs": dict(kwargs)},
-                        stimulus_id,
-                    )
                 # per-event fault isolation, same as the per-message path
                 # (handle_stream logs one failure and proceeds): a poison
                 # event must not discard the flood's already-accumulated
@@ -2821,6 +2864,64 @@ class SchedulerState:
             }]}
         return {}, {}
 
+    def stimulus_scatter_data(
+        self, key: Key, holders: list[str], nbytes: int,
+        client: str | None, stimulus_id: str,
+    ) -> tuple[dict, dict]:
+        """Pure data landed on workers out-of-band (the pure per-key part
+        of ``Scheduler.scatter``; the sim's scatter drives it directly).
+
+        Journaled: scattered data enters ``memory`` through the engine
+        but from no worker stimulus, so a journal tail without these
+        records replays a cluster whose root partitions never existed."""
+        holders = [a for a in holders if a in self.workers]
+        if not holders:
+            return {}, {}
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "scatter-data",
+                {"key": key, "workers": list(holders), "nbytes": int(nbytes),
+                 "client": client},
+                stimulus_id,
+            )
+        ts = self.tasks.get(key)
+        if ts is None:
+            ts = self.new_task(key, None, "released")
+        if client is not None:
+            # register the client's interest BEFORE entering memory via
+            # the engine, or the no-waiters/no-wants GC releases the key
+            self.client_desires_keys([key], client)
+        if ts.state not in ("released", "memory"):
+            # key collides with a task mid-flight: leave the scheduler
+            # state machine alone (the worker copy is surplus data)
+            logger.warning(
+                "scatter ignoring key %r already in state %r", key, ts.state
+            )
+            return {}, {}
+        if ts.priority is None:
+            ts.priority = (0, 0, 0)
+        client_msgs: dict = {}
+        worker_msgs: dict = {}
+        if ts.state == "released":
+            # through the engine so accounting stays consistent and
+            # waiting dependents are recommended onward
+            recs, cmsgs, wmsgs = self._transition(
+                key, "memory", stimulus_id,
+                worker=holders[0], nbytes=int(nbytes),
+            )
+            _merge_msgs_inplace(client_msgs, cmsgs)
+            _merge_msgs_inplace(worker_msgs, wmsgs)
+            self._transitions(recs, client_msgs, worker_msgs, stimulus_id)
+            extra = holders[1:]
+        else:
+            self.update_nbytes(ts, int(nbytes))
+            extra = holders
+        for addr in extra:
+            ws = self.workers.get(addr)
+            if ws is not None:
+                self.add_replica(ts, ws)
+        return client_msgs, worker_msgs
+
     def stimulus_long_running(
         self, key: Key, worker: str, compute_duration: float,
         stimulus_id: str,
@@ -2847,8 +2948,48 @@ class SchedulerState:
         ws.long_running.add(ts)
         if self.native is not None:
             self.native.mark_task(ts)
+        if self.durability is not None:
+            self.durability.mark_replica(ts, ws)
         self.check_idle_saturated(ws)
         return {}, {}
+
+    def stimulus_steal_move(
+        self, key: Key, victim: str, thief: str, stimulus_id: str,
+        kind: str = "steal",
+    ) -> tuple[dict, dict]:
+        """Re-place a processing task from ``victim`` onto ``thief`` —
+        the resolved outcome of a steal confirm (or a speculative move).
+
+        Extracted from ``WorkStealing.move_task_confirm`` so the move is
+        journaled as its own replayable op: the confirm path mutates
+        ``processing_on`` OUTSIDE the transition engine, and a journal
+        tail spanning a confirmed steal would otherwise reconstruct the
+        task on the wrong worker (the restart-during-in-flight-steal
+        case).  Guards mirror the confirm path; a guard miss is a no-op
+        both live and on replay."""
+        ts = self.tasks.get(key)
+        if ts is None or ts.state != "processing":
+            return {}, {}
+        victim_ws = self.workers.get(victim)
+        thief_ws = self.workers.get(thief)
+        if victim_ws is None or ts.processing_on is not victim_ws:
+            return {}, {}
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "steal-move",
+                {"key": key, "victim": victim, "thief": thief, "kind": kind},
+                stimulus_id,
+            )
+        if thief_ws is None or thief_ws not in self.running:
+            # thief died meanwhile: reschedule from scratch
+            return self._transitions_observed({key: "released"}, stimulus_id)
+        self._exit_processing_common(ts)
+        ts.state = "waiting"  # transient; re-enter processing on thief
+        victim_ws.long_running.discard(ts)
+        worker_msgs = self._add_to_processing(
+            ts, thief_ws, stimulus_id, kind=kind
+        )
+        return {}, worker_msgs
 
     def stimulus_reschedule(
         self, key: Key, worker: str, stimulus_id: str
@@ -2919,6 +3060,19 @@ class SchedulerState:
         """Register a worker (pure part of reference add_worker :4308)."""
         if address in self.workers:
             return self.workers[address]
+        if self.trace.journal_enabled:
+            # worker registration is structural state the engine stimuli
+            # assume: a journal tail spanning an autoscale join must
+            # replay it or every later placement references a ghost
+            self.trace.record(
+                "add-worker",
+                {"address": address, "nthreads": int(nthreads),
+                 "memory_limit": int(memory_limit),
+                 "name": name if isinstance(name, (str, int, float, type(None))) else str(name),
+                 "resources": dict(resources or {}),
+                 "server_id": server_id},
+                f"add-worker-{address}",
+            )
         ws = WorkerState(
             address, nthreads=nthreads, memory_limit=memory_limit, name=name,
             server_id=server_id,
@@ -2942,6 +3096,8 @@ class SchedulerState:
             self.mirror.on_add_worker(ws)
         if self.native is not None:
             self.native.on_add_worker(ws)
+        if self.durability is not None:
+            self.durability.mark_worker(ws)
         self.check_idle_saturated(ws)
         if self.placement is not None:
             self.placement.on_add_worker(self, ws)
@@ -2960,6 +3116,8 @@ class SchedulerState:
             self.mirror.mark(ws)
         if self.native is not None:
             self.native.mark_worker(ws)
+        if self.durability is not None:
+            self.durability.mark_worker(ws)
 
     def set_worker_nthreads(self, ws: WorkerState, nthreads: int) -> None:
         """Mirror-aware worker resize.  No production message resizes a
@@ -2970,8 +3128,62 @@ class SchedulerState:
         ws.nthreads = nthreads
         if self.native is not None:
             self.native.mark_worker(ws)
+        if self.durability is not None:
+            self.durability.mark_worker(ws)
         self.total_nthreads_history.append((self.clock(), self.total_nthreads))
         self.check_idle_saturated(ws)
+
+    def stimulus_worker_status_change(
+        self, worker: str, status: str, status_seq: int,
+        stimulus_id: str,
+    ) -> tuple[dict, dict]:
+        """Pure body of the server's worker-status-change handler: the
+        running/idle membership flips, homed-task release and parked
+        splicing happen OUTSIDE the engine, so the op journals itself
+        and the engine rounds it triggers replay from this record."""
+        ws = self.workers.get(worker)
+        if ws is None:
+            return {}, {}
+        if status_seq >= 0 and status_seq < ws.status_seq:
+            # stale stream message ordered behind a fresher flip
+            # (possible after a heartbeat-applied reconciliation)
+            return {}, {}
+        if self.trace.journal_enabled:
+            self.trace.record(
+                "worker-status-change",
+                {"worker": worker, "status": status,
+                 "status_seq": int(status_seq)},
+                stimulus_id,
+            )
+        self.set_worker_status(
+            ws, status, status_seq if status_seq >= 0 else None
+        )
+        ws.status_changed_at = self.clock()
+        if status == WORKER_STATUS_PAUSED:
+            self.running.discard(ws)
+            self.idle.pop(ws.address, None)
+            self.idle_task_count.discard(ws)
+            # home-stacked tasks on a paused worker become stealable
+            # again — nothing else would move them off a stalled home
+            steal = self.extensions.get("stealing")
+            for ts in ws.processing:
+                if ts.homed:
+                    ts.homed = False
+                    if steal is not None:
+                        steal.put_key_in_stealable(ts)
+            # a paused home can't pull: return its parked tasks to the
+            # global pop heap and let open slots elsewhere take them
+            if ws.address in self.parked:
+                self.splice_parked(ws.address)
+                recs = self.stimulus_queue_slots_maybe_opened(stimulus_id)
+                return self._transitions_observed(recs, stimulus_id)
+        elif status == WORKER_STATUS_RUNNING:
+            self.running.add(ws)
+            self.check_idle_saturated(ws)
+            recs = self.bulk_schedule_unrunnable_after_adding_worker(ws)
+            recs.update(self.stimulus_queue_slots_maybe_opened(stimulus_id))
+            return self._transitions_observed(recs, stimulus_id)
+        return {}, {}
 
     def bulk_schedule_unrunnable_after_adding_worker(self, ws: WorkerState) -> dict[Key, str]:
         """Try no-worker tasks on the new worker (reference scheduler.py:3173)."""
@@ -3031,6 +3243,8 @@ class SchedulerState:
             self.mirror.on_remove_worker(ws)
         if self.native is not None:
             self.native.on_remove_worker(ws)
+        if self.durability is not None:
+            self.durability.on_remove_worker(ws)
         if self.placement is not None:
             self.placement.on_remove_worker(self, ws)
         # tasks parked for the dead worker become globally poppable again
@@ -3092,6 +3306,14 @@ class SchedulerState:
         return cs
 
     def client_desires_keys(self, keys: Iterable[Key], client: str) -> None:
+        keys = list(keys)
+        if self.trace.journal_enabled:
+            # client interest gates the release/forget GC: a tail
+            # replayed without it forgets keys the client still holds
+            self.trace.record(
+                "client-desires-keys", {"keys": keys, "client": client},
+                f"client-desires-{client}",
+            )
         cs = self.add_client_state(client)
         for key in keys:
             ts = self.tasks.get(key)
@@ -3101,6 +3323,8 @@ class SchedulerState:
             cs.wants_what.add(ts)
             if self.native is not None:
                 self.native.on_who_wants(ts)
+            if self.durability is not None:
+                self.durability.mark_task(ts)
 
     def client_releases_keys(
         self, keys: Iterable[Key], client: str, stimulus_id: str
@@ -3109,6 +3333,16 @@ class SchedulerState:
         cs = self.clients.get(client)
         if cs is None:
             return {}, {}
+        keys = list(keys)
+        if self.trace.journal_enabled:
+            # journaled as its own op (the interest mutation happens
+            # OUTSIDE the engine); the engine round below is re-derived
+            # on replay, so it must NOT write a nested "transitions"
+            # record — the reschedule/missing-data rule
+            self.trace.record(
+                "client-releases-keys", {"keys": keys, "client": client},
+                stimulus_id,
+            )
         recommendations: dict[Key, str] = {}
         for key in keys:
             ts = self.tasks.get(key)
@@ -3118,12 +3352,14 @@ class SchedulerState:
             ts.who_wants.discard(cs)
             if self.native is not None:
                 self.native.on_who_wants(ts)
+            if self.durability is not None:
+                self.durability.mark_task(ts)
             if not ts.who_wants:
                 if not ts.dependents:
                     recommendations[key] = "forgotten"
                 elif not ts.waiters:
                     recommendations[key] = "released"
-        return self.transitions(recommendations, stimulus_id)
+        return self._transitions_observed(recommendations, stimulus_id)
 
     def remove_client_state(self, client: str, stimulus_id: str) -> tuple[dict, dict]:
         cs = self.clients.get(client)
@@ -3171,6 +3407,40 @@ class SchedulerState:
                 for k, deps in dependencies.items()
             }
             priorities = {k: (r,) for k, r in order_fn(pruned).items()}
+
+        if self.trace.journal_enabled:
+            # graph intake is journaled with RESOLVED priorities and
+            # per-dependency lists in this call's exact iteration order,
+            # so a tail replay materializes bit-identical TaskStates
+            # (insertion order of the relation sets included) without
+            # re-running graph.order.  run_specs are encoded to a
+            # JSON-pure form (scheduler/durability.py) so the record's
+            # digest survives a dump/load round trip and a restarted
+            # scheduler can still dispatch the tasks.  The engine round
+            # at the end of this method is re-derived on replay and
+            # must not write a nested "transitions" record.
+            from distributed_tpu.scheduler.durability import encode_run_spec
+
+            self.trace.record(
+                "update-graph",
+                {
+                    "tasks": {k: encode_run_spec(v) for k, v in tasks.items()},
+                    "dependencies": {
+                        k: list(v) for k, v in dependencies.items()
+                    },
+                    "keys": list(keys),
+                    "priorities": {
+                        k: list(v) for k, v in priorities.items()
+                    },
+                    "client": client,
+                    "user_priority": user_priority,
+                    "generation": generation,
+                    "annotations_by_key": annotations_by_key,
+                    "retries": retries,
+                    "actors": actors,
+                },
+                stimulus_id,
+            )
 
         # reuse a trailing EMPTY computation: dependency-only or
         # already-known-key submissions must not flush real history out
@@ -3306,7 +3576,11 @@ class SchedulerState:
         for ts in sorted(wanted, key=lambda ts: ts.priority or (0,), reverse=True):
             if ts.state == "released" and ts.run_spec is not None:
                 recommendations[ts.key] = "waiting"
-        client_msgs, worker_msgs = self.transitions(recommendations, stimulus_id)
+        # _transitions_observed, NOT transitions: the update-graph
+        # journal record above replays this round itself
+        client_msgs, worker_msgs = self._transitions_observed(
+            recommendations, stimulus_id
+        )
         # immediately report already-completed keys
         for key in keys:
             ts = self.tasks.get(key)

@@ -29,6 +29,7 @@ from distributed_tpu import config
 from distributed_tpu.exceptions import CommClosedError
 from distributed_tpu.graph.spec import Key
 from distributed_tpu.rpc.core import PeriodicCallback
+from distributed_tpu.utils import OrderedSet
 from distributed_tpu.utils.misc import seq_name, time
 
 if TYPE_CHECKING:
@@ -61,7 +62,7 @@ class WorkStealing:
         self.scheduler = scheduler
         self.state = scheduler.state
         # stealable[worker_address][level] -> set of TaskStates
-        self.stealable: dict[str, list[set]] = {}
+        self.stealable: dict[str, list[OrderedSet]] = {}
         self.key_stealable: dict[Key, tuple[str, int]] = {}
         # in-flight steal requests awaiting worker confirmation
         self.in_flight: dict[Key, InFlightInfo] = {}
@@ -120,7 +121,11 @@ class WorkStealing:
     # -------------------------------------------------------- plugin hooks
 
     def add_worker_state(self, ws: "WorkerState") -> None:
-        self.stealable[ws.address] = [set() for _ in range(N_LEVELS)]
+        # OrderedSet: the balance cycle steals a level's tasks in
+        # iteration order, and restart recovery rebuilds these from the
+        # snapshot's key_stealable order (scheduler/durability.py) — a
+        # hash-ordered set cannot reproduce the pre-crash scan order
+        self.stealable[ws.address] = [OrderedSet() for _ in range(N_LEVELS)]
 
     def add_worker(self, scheduler: Any, address: str) -> None:
         ws = self.state.workers.get(address)
@@ -212,6 +217,24 @@ class WorkStealing:
 
     # ------------------------------------------------------- move protocol
 
+    def seed_in_flight(self, ts: "TaskState", victim: "WorkerState",
+                       thief: "WorkerState", victim_duration: float,
+                       thief_duration: float, stimulus_id: str) -> None:
+        """Open one confirm window: the ``in_flight`` entry plus its
+        occupancy/task-count overlays.  The ONE copy of this
+        bookkeeping, shared by the live move (``move_task_request``),
+        the snapshot restore (``durability.restore_stealing``), and the
+        journal replay (``flight_recorder``) — a change landing in only
+        one copy diverges a restored scheduler's next balance cycle
+        from the unbounced twin."""
+        self.in_flight[ts.key] = InFlightInfo(
+            victim, thief, victim_duration, thief_duration, stimulus_id
+        )
+        self.in_flight_occupancy[victim] -= victim_duration
+        self.in_flight_occupancy[thief] += thief_duration
+        self.in_flight_tasks[victim] += 1
+        self._in_flight_event.clear()
+
     def move_task_request(self, ts: "TaskState", victim: "WorkerState",
                           thief: "WorkerState") -> None:
         """Ask the victim to relinquish ts (reference stealing.py:279)."""
@@ -240,13 +263,22 @@ class WorkStealing:
                 ts, thief, stimulus_id, "steal", compute, comm_cost
             )
         self.remove_key_from_stealable(ts)
-        self.in_flight[key] = InFlightInfo(
-            victim, thief, victim_duration, thief_duration, stimulus_id
+        if self.state.trace.journal_enabled:
+            # the confirm window is cross-payload scheduler truth: a
+            # durable tail spanning an unanswered steal-request must
+            # rebuild this in_flight entry or the victim's eventual
+            # steal-response finds nothing and the move is dropped
+            # (scheduler/durability.py; replayed by flight_recorder)
+            self.state.trace.record(
+                "steal-request",
+                {"key": key, "victim": victim.address,
+                 "thief": thief.address, "vd": repr(victim_duration),
+                 "td": repr(thief_duration)},
+                stimulus_id,
+            )
+        self.seed_in_flight(
+            ts, victim, thief, victim_duration, thief_duration, stimulus_id
         )
-        self.in_flight_occupancy[victim] -= victim_duration
-        self.in_flight_occupancy[thief] += thief_duration
-        self.in_flight_tasks[victim] += 1
-        self._in_flight_event.clear()
         try:
             self.scheduler.send_all({}, {victim.address: [{
                 "op": "steal-request", "key": key, "stimulus_id": stimulus_id,
@@ -282,13 +314,12 @@ class WorkStealing:
         # (constant=None: recomputed only behind the sampling gate)
         self.state.shadow_comm_cost(ts, thief, None, "steal", stimulus_id)
         self.remove_key_from_stealable(ts)
-        self.state._exit_processing_common(ts)
-        ts.state = "waiting"  # transient; re-enter processing on thief
-        victim.long_running.discard(ts)
-        # ledger kind "steal-spec": the re-placement row supersedes the
-        # victim placement's open row in one step (no confirm leg)
-        ws_msgs = self.state._add_to_processing(
-            ts, thief, stimulus_id, kind="steal-spec"
+        # the journaled engine twin performs the move (ledger kind
+        # "steal-spec": the re-placement row supersedes the victim
+        # placement's open row in one step — no confirm leg)
+        _cm, ws_msgs = self.state.stimulus_steal_move(
+            key, victim.address, thief.address, stimulus_id,
+            kind="steal-spec",
         )
         msgs = {victim.address: [{
             "op": "free-keys", "keys": [key], "stimulus_id": stimulus_id,
@@ -308,7 +339,23 @@ class WorkStealing:
                                 **kwargs: Any) -> None:
         """The victim answered (reference stealing.py:333)."""
         info = self.in_flight.pop(key, None)
-        if info is None or info.stimulus_id != stimulus_id:
+        if info is None:
+            return
+        if self.state.trace.journal_enabled:
+            # the CLOSE of the confirm window is cross-payload truth
+            # too: without this record a tail spanning request+answer
+            # replays the in_flight entry back to life (occupancy
+            # overlays included) and the bounced scheduler's next
+            # balance cycle diverges from the unbounced twin.  matched
+            # mirrors the stimulus fence: a mismatched answer consumes
+            # the window but must not revert overlays (exactly the live
+            # semantics below).
+            self.state.trace.record(
+                "steal-confirm",
+                {"key": key, "matched": info.stimulus_id == stimulus_id},
+                stimulus_id,
+            )
+        if info.stimulus_id != stimulus_id:
             return
         victim, thief = info.victim, info.thief
         self.in_flight_occupancy[thief] -= info.thief_duration
@@ -325,32 +372,27 @@ class WorkStealing:
         if self.state.workers.get(victim.address) is not victim:
             return
         if state in ("ready", "waiting"):
-            # victim gave it up: reassign to thief
-            if self.state.workers.get(thief.address) is not thief or (
-                thief not in self.state.running
-            ):
-                # thief died meanwhile: reschedule from scratch
-                cm, wm = self.state.transitions(
-                    {key: "released"}, stimulus_id
+            # victim gave it up: reassign to thief through the journaled
+            # engine twin (stimulus_steal_move) — the definitive "steal"
+            # ledger row supersedes the request row filed at
+            # move_task_request and joins at memory with the regret.  A
+            # dead thief degrades to reschedule-from-scratch inside the
+            # twin; either way the move replays from the journal tail.
+            thief_alive = (
+                self.state.workers.get(thief.address) is thief
+                and thief in self.state.running
+            )
+            cm, wm = self.state.stimulus_steal_move(
+                key, victim.address, thief.address, stimulus_id,
+                kind="steal",
+            )
+            if thief_alive:
+                self.count += 1
+                self.log.append(
+                    ("confirm", key, victim.address, thief.address)
                 )
-                self.scheduler.send_all(cm, wm)
-                return
-            self.state._exit_processing_common(ts)
-            ts.state = "waiting"  # transient; re-enter processing on thief
-            duration = info.thief_duration
-            victim.long_running.discard(ts)
-            # the definitive "steal" ledger row: supersedes the request
-            # row filed at move_task_request (whose lifetime records the
-            # confirm round trip) and joins at memory with the regret
-            ws_msgs = self.state._add_to_processing(
-                ts, thief, stimulus_id, kind="steal"
-            )
-            self.count += 1
-            self.log.append(
-                ("confirm", key, victim.address, thief.address)
-            )
-            self.metrics["request_count_total"][victim.address] += 1
-            self.scheduler.send_all({}, ws_msgs)
+                self.metrics["request_count_total"][victim.address] += 1
+            self.scheduler.send_all(cm, wm)
         else:
             # already executing (or gone): leave it
             if ts.ledger_row >= 0:
@@ -395,6 +437,20 @@ class WorkStealing:
 
     def balance(self) -> None:
         """One stealing cycle (reference stealing.py:402)."""
+        rr0 = self._rr
+        self._balance_cycle()
+        if self._rr != rr0 and self.state.trace.journal_enabled:
+            # the dep-free round-robin cursor advanced this cycle — and
+            # not every advance pairs with a journaled steal-request (a
+            # candidate can fail _steal_pays after the rotation).  The
+            # cursor picks future thieves, so a durable tail must pin it
+            # or a restored scheduler's next balance diverges from the
+            # unbounced twin (scheduler/durability.py).
+            self.state.trace.record(
+                "steal-rr", {"rr": self._rr}, self.seq("steal-rr")
+            )
+
+    def _balance_cycle(self) -> None:
         self._last_balance = self.clock()
         s = self.state
         if not s.idle or len(s.workers) < 2:

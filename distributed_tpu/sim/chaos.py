@@ -179,9 +179,78 @@ def scenario_poison_flood(
     return sim, report
 
 
+def scenario_scheduler_bounce(
+    seed: int = 5, n_workers: int = 12, model: dict | None = None,
+    bounce_at: float = 0.05, snapshot_interval: float = 0.015,
+    native: bool | None = False,
+) -> tuple[ClusterSim, dict]:
+    """The scheduler PROCESS dies mid-graph and restarts from its
+    durable snapshot + journal tail (scheduler/durability.py;
+    docs/durability.md).  Workers keep their data and state machines —
+    the real topology of a scheduler bounce.
+
+    Proves the whole recovery contract deterministically:
+
+    - the restored-then-replayed state is bit-identical to the state
+      that died (``bounce_scheduler`` asserts the structural digest and
+      the transition counter inside the bounce);
+    - the run converges with zero lost completed keys and zero
+      transitions outside the ``docs/state_machine/`` model;
+    - the POST-recovery stream is digest-identical to an unbounced
+      same-seed twin — durable capture and recovery are transparent to
+      scheduling behavior, steal round-robin cursor included.
+
+    ``native`` selects the transition engine (``False`` = pure-python
+    oracle, ``True`` = compiled) — the contract holds across both.
+    The native arm runs ``validate=False`` (a validating state never
+    admits the compiled engine); model compliance still gates via the
+    recorder plugin either way.
+    """
+    def build() -> ClusterSim:
+        if native:
+            sim = ClusterSim(n_workers, seed=seed, validate=False,
+                             native=True)
+            sim.install_digest()
+            return sim
+        return _base_sim(n_workers, seed, native=native)
+
+    sim = build()
+    recorder = install_recorder(sim)
+    trace = _base_trace(seed)
+    trace.start(sim)
+    sim.enable_durability(snapshot_interval=snapshot_interval)
+    sim.bounce_scheduler(at=bounce_at)
+    sim.run()
+    report = _finish(sim, recorder, model)
+    if sim.counters["scheduler_bounces"] != 1:
+        raise AssertionError(
+            "the bounce never fired: schedule it before the workload "
+            f"drains (bounce_at={bounce_at}, "
+            f"makespan={sim.makespan})"
+        )
+    report["bounce_tail_records"] = sim.counters["bounce_tail_records"]
+    report["durability_snapshots"] = sim.counters["durability_snapshots"]
+
+    # unbounced same-seed twin WITHOUT durability: capture + bounce +
+    # recovery must be invisible in the whole-run transition stream
+    twin = build()
+    _base_trace(seed).start(twin)
+    twin.run()
+    check_no_lost_keys(twin)
+    if sim.digest() != twin.digest():
+        raise AssertionError(
+            "bounced run diverged from the unbounced same-seed twin: "
+            f"{sim.digest()} != {twin.digest()} (recovery is not "
+            "transparent)"
+        )
+    report["twin_digest"] = twin.digest()
+    return sim, report
+
+
 SCENARIOS = {
     "worker-death": scenario_worker_death,
     "partition": scenario_partition,
     "straggler": scenario_straggler,
     "poison-flood": scenario_poison_flood,
+    "scheduler-bounce": scenario_scheduler_bounce,
 }

@@ -112,6 +112,12 @@ class FlightRecorder:
         self.journal_enabled = bool(journal)
         self.journal: deque[dict] = deque(maxlen=max(int(journal_size), 1))
         self._journal_seq = 0  # records ever journaled (capture ordinal)
+        # durable capture hook (scheduler/durability.py): called with
+        # every journal record AFTER it lands in the deque.  The segment
+        # writer subscribes here so a long capture stays complete on
+        # disk even after the bounded deque evicts its head — the
+        # eviction race ``verify_journal`` can only detect, never fix.
+        self.journal_sink: Any | None = None
 
     # ------------------------------------------------------------ fast path
 
@@ -161,15 +167,23 @@ class FlightRecorder:
         (use :meth:`journal_start` to begin a fresh capture)."""
         seq = self._journal_seq
         self._journal_seq = seq + 1
-        self.journal.append({
+        sink = self.journal_sink
+        rec = {
             "v": TRACE_SCHEMA_VERSION,
             "seq": seq,
             "op": op,
             "stim": stim,
             "ts": self.clock(),
-            "digest": payload_digest(payload),
+            # with a durable sink attached the digest is stamped at
+            # segment-append time (stamp_digests — off the engine hot
+            # path, on the writer thread in production); the deque
+            # holds the SAME dict, so the in-memory record heals too
+            "digest": payload_digest(payload) if sink is None else None,
             "payload": payload,
-        })
+        }
+        self.journal.append(rec)
+        if sink is not None:
+            sink(rec)
 
     def journal_start(self) -> None:
         """Begin a fresh replayable capture: clear the journal, reset
@@ -268,6 +282,68 @@ def load_journal(path: str) -> list[dict]:
     flight_recorder) runs digest + contiguity checks before any replay."""
     with open(path) as f:
         return from_jsonl(f.read())
+
+
+def atomic_write_bytes(path: str, blob: bytes) -> int:
+    """Crash-consistent file write: temp sibling, flush, ``fsync``,
+    ``os.replace``, directory ``fsync``.  A reader never observes a
+    half-written file — it sees the old content or the new, which is
+    the property the durability snapshots (scheduler/durability.py)
+    build their no-torn-snapshot contract on.  Returns bytes written."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return len(blob)
+
+
+def append_jsonl(path: str, records: Iterable[dict], fsync: bool = True) -> int:
+    """Append records to a JSONL file (journal segments), optionally
+    fsync'd.  Appends are NOT atomic: a crash mid-append leaves a torn
+    final line, which the durability loader treats as
+    never-made-durable and drops (docs/durability.md).  Returns bytes
+    appended."""
+    import os
+
+    blob = to_jsonl(records).encode()
+    if not blob:
+        return 0
+    with open(path, "ab") as f:
+        f.write(blob)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    return len(blob)
+
+
+def read_file_bytes(path: str) -> bytes:
+    """Read one file whole (the durability loaders' delegated IO —
+    scheduler/durability.py is in the sans-io lint scope and never
+    opens files itself)."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def stamp_digests(records: Iterable[dict]) -> None:
+    """Fill missing payload digests in place.  Durable capture defers
+    digest computation off the engine hot path (FlightRecorder.record
+    leaves ``digest: None`` while a journal_sink is attached); the
+    durability sinks stamp here immediately before serializing a
+    segment — on the writer thread in the live scheduler.  Records in
+    the bounded deque are the same dict objects, so stamping heals the
+    in-memory journal for ``verify_journal``/dump consumers too."""
+    for rec in records:
+        if rec.get("digest") is None:
+            rec["digest"] = payload_digest(rec["payload"])
 
 
 def payload_digest(payload: Any) -> str:

@@ -463,28 +463,94 @@ class Worker(Server):
             logger.warning("lifetime retire failed", exc_info=True)
         self._ongoing_background_tasks.call_soon(self.close)
 
+    def _register_backoff(self, purpose: str):
+        """One backoff policy for both registration loops: exponential
+        from ``worker.register.base-delay`` capped at ``.max-delay``,
+        jittered in [0.5, 1.5) by an rng seeded per (worker id,
+        purpose) — deterministic in tests, decorrelated across a fleet
+        re-registering after a scheduler bounce.  Returns
+        ``delay(attempt)`` with attempts counted from 1."""
+        import random
+
+        base = config.parse_timedelta(
+            config.get("worker.register.base-delay")
+        )
+        max_delay = config.parse_timedelta(
+            config.get("worker.register.max-delay")
+        )
+        rng = random.Random(f"{self.id}-{purpose}")
+
+        def delay(attempt: int) -> float:
+            return min(max_delay, base * 2 ** (attempt - 1)) * (
+                0.5 + rng.random()
+            )
+
+        return delay
+
     async def _register_with_scheduler(self) -> None:
-        """Handshake + dual stream with the scheduler (reference worker.py:1164)."""
+        """Handshake + dual stream with the scheduler (reference
+        worker.py:1164), with retry/backoff + jitter: a handshake that
+        times out (or whose reply is lost) retries on a fresh comm —
+        safe because the scheduler side is idempotent per ``server_id``
+        (a retry after a half-applied registration reuses the state
+        row; replicas and occupancy never double-count)."""
+        retries = int(config.get("worker.register.retries"))
+        backoff = self._register_backoff("register")
+        attempt = 0
+        while True:
+            try:
+                await self._register_once()
+                return
+            except (CommClosedError, OSError, asyncio.TimeoutError) as exc:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                delay = backoff(attempt)
+                logger.info(
+                    "register-worker attempt %d/%d failed (%s); retrying "
+                    "in %.2fs", attempt, retries, exc, delay,
+                )
+                await asyncio.sleep(delay)
+
+    async def _register_once(self) -> None:
         comm = await connect(self.scheduler_addr, **self.connection_args)
+        from distributed_tpu.scheduler.durability import worker_held_keys
         from distributed_tpu.versions import get_versions
 
-        await comm.write(
-            {
-                "op": "register-worker",
-                "address": self.address,
-                "nthreads": self.nthreads,
-                "nanny": self.nanny_addr,
-                "name": self.name,
-                "memory_limit": self.memory_limit,
-                "resources": self.state.total_resources,
-                "server_id": self.id,
-                "versions": get_versions(),
-                "jax_devices": self.jax_device_indices,
-                "reply": False,
-            }
-        )
-        resp = await comm.read()
+        try:
+            await comm.write(
+                {
+                    "op": "register-worker",
+                    "address": self.address,
+                    "nthreads": self.nthreads,
+                    "nanny": self.nanny_addr,
+                    "name": self.name,
+                    "memory_limit": self.memory_limit,
+                    "resources": self.state.total_resources,
+                    "server_id": self.id,
+                    "versions": get_versions(),
+                    "jax_devices": self.jax_device_indices,
+                    # stored data inventory: a restarted scheduler's
+                    # recovery window rebuilds/cross-checks who_has
+                    # from this (scheduler/durability.py)
+                    "held_keys": worker_held_keys(self.state),
+                    "reply": False,
+                }
+            )
+            # bounded read: a scheduler that accepted the connection but
+            # wedged before replying must not hang registration forever
+            # — the retry loop above owns recovery
+            resp = await asyncio.wait_for(
+                comm.read(),
+                timeout=config.parse_timedelta(
+                    config.get("comm.timeouts.connect")
+                ) or 30.0,
+            )
+        except BaseException:
+            await comm.close()
+            raise
         if resp.get("status") != "OK":
+            await comm.close()
             raise ValueError(f"scheduler rejected worker: {resp!r}")
         self.scheduler_comm = comm
         self.batched_stream.start(comm)
@@ -497,8 +563,45 @@ class Worker(Server):
             await self.handle_stream(comm)
         finally:
             if self.status not in (Status.closing, Status.closed, Status.failed):
+                attempts = int(config.get("worker.reconnect-attempts"))
+                if attempts > 0 and await self._reconnect_to_scheduler(attempts):
+                    return
                 logger.info("connection to scheduler lost; closing %s", self.address)
                 await self.close()
+
+    async def _reconnect_to_scheduler(self, attempts: int) -> bool:
+        """Scheduler-bounce survival: the stream died but this worker
+        keeps its data and state machine — re-register with backoff +
+        jitter (carrying ``held_keys``) so a restarted scheduler's
+        recovery window can rebuild ``who_has`` instead of recomputing
+        everything this worker already holds."""
+        backoff = self._register_backoff("reconnect")
+        for attempt in range(1, attempts + 1):
+            await asyncio.sleep(backoff(attempt))
+            if self.status in (Status.closing, Status.closed, Status.failed):
+                return False
+            # the old stream is dead: tear it down and hand the state
+            # machine a fresh buffering BatchedSend before the handshake
+            await self.batched_stream.close()
+            self.batched_stream = BatchedSend()
+            if self.scheduler_comm is not None:
+                await self.scheduler_comm.close()
+                self.scheduler_comm = None
+            try:
+                await self._register_once()
+            except (CommClosedError, OSError, asyncio.TimeoutError,
+                    ValueError) as exc:
+                logger.info(
+                    "scheduler reconnect attempt %d/%d failed: %s",
+                    attempt, attempts, exc,
+                )
+                continue
+            logger.info(
+                "%s reconnected to scheduler after %d attempt(s)",
+                self.address, attempt,
+            )
+            return True
+        return False
 
     async def heartbeat(self) -> None:
         if self.batched_stream.closed():

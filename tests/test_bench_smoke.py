@@ -166,3 +166,48 @@ def test_bench_smoke_runs_and_reports():
     assert sim["chaos_death_lost"] == 0
     assert sim["replay_match"] is True
     assert sim["replay_rows"] > 0
+
+
+def test_bench_smoke_restart():
+    """Scheduler-durability gate (scheduler/durability.py;
+    docs/durability.md), run standalone via the ``--smoke restart``
+    selector: a live TCP cluster computes keys, the scheduler snapshots
+    and is HARD-bounced (comms aborted, no graceful close), a fresh
+    scheduler restarts on the same port from snapshot + journal tail,
+    the workers reconnect on their own carrying held_keys — zero
+    completed keys lost, recovery under the RTO budget, fresh work
+    computes after.  Plus the synthetic halves: steady-state capture
+    overhead <5% (min-per-pair-ratio) and the digest-verified
+    measured-RTO curve over snapshot cadence x journal-tail length."""
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "restart"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    line = [
+        ln for ln in proc.stdout.splitlines() if ln.strip().startswith("{")
+    ][-1]
+    out = json.loads(line)
+    restart = out["configs"]["restart"]
+    # the live hard-bounce half
+    assert restart["lost_completed_keys"] == 0
+    assert restart["pre_keys"] >= 50
+    assert restart["rto_live_s"] < 30.0
+    assert restart["restore_s"] > 0
+    assert restart["replay_records"] > 0  # snapshot + TAIL, not snapshot alone
+    assert restart["workers_reregistered"] == 2
+    assert restart["liveness_ok"] is True
+    # steady-state capture overhead (dirty tracker + journal segments)
+    assert restart["overhead_pct"] < 5.0
+    assert restart["amortized_snapshot_pct"] < 5.0
+    # the measured-RTO curve: every point digest-verified, spanning
+    # many-deltas/short-tail through base-only/whole-flood-tail
+    curve = restart["rto_curve"]
+    assert len(curve) == 3
+    assert all(p["digest_ok"] for p in curve)
+    assert all(p["restore_s"] > 0 for p in curve)
+    assert curve[0]["epochs"] > curve[-1]["epochs"]
+    assert curve[-1]["tail_records"] > curve[0]["tail_records"]

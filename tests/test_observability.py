@@ -668,7 +668,12 @@ def test_record_replay_round_trip():
     assert rounds >= 2, "not a multi-flood run"
     records = list(rec.trace.journal)
     assert records and all(r["v"] == 1 for r in records)
-    assert all(r["op"] in ("task-finished", "transitions") for r in records)
+    # floods journal as ONE record per engine batch (the durable-
+    # capture hot-path format; scalar "task-finished" remains for the
+    # single-RPC path)
+    assert all(
+        r["op"] in ("tasks-finished-batch", "transitions") for r in records
+    )
     verify_journal(records)
 
     rep = _build_trace_state()
@@ -735,7 +740,7 @@ def test_record_replay_erred_and_transitions_ops():
     mark = len(rec.transition_log)
     drive(rec)
     ops = [r["op"] for r in rec.trace.journal]
-    assert "task-finished" in ops and "task-erred" in ops
+    assert "tasks-finished-batch" in ops and "task-erred" in ops
     assert "release-worker-data" in ops and "transitions" in ops
 
     rep = _build_trace_state()
@@ -1111,6 +1116,16 @@ def test_metrics_names_unique_and_documented():
     # toolchain exists; a no-g++ box skips them (graceful fallback is
     # the contract, and the names stay documented either way)
     _Sched.state.attach_native(build=True)
+    # seed scheduler durability (scheduler/durability.py) so the
+    # dtpu_durability_* family is exercised: an attached manager with
+    # one epoch's stats
+    from distributed_tpu.scheduler.durability import (
+        DurabilityManager,
+        MemorySink,
+    )
+
+    _Sched.durability = DurabilityManager(_Sched.state, MemorySink())
+    _Sched.durability.snapshot(full=True)
 
     class _SpillDict(dict):  # enables the spill metric lines
         spilled_count = 0
@@ -1193,6 +1208,18 @@ def test_metrics_names_unique_and_documented():
             "dtpu_ledger_link_regret_seconds_total",
             "dtpu_ledger_link_transfer_seconds_total",
             "dtpu_ledger_link_decisions_total",
+            "dtpu_durability_snapshot_seconds_total",
+            "dtpu_durability_snapshot_bytes_total",
+            "dtpu_durability_snapshot_rows_total",
+            "dtpu_durability_epochs_total",
+            "dtpu_durability_base_epochs_total",
+            "dtpu_durability_journal_records_total",
+            "dtpu_durability_journal_bytes_total",
+            "dtpu_durability_replay_records",
+            "dtpu_durability_restore_seconds",
+            "dtpu_durability_torn_records_total",
+            "dtpu_durability_reconcile_corrections_total",
+            "dtpu_durability_recovery_awaiting_workers",
             "dtpu_mirror_shard_rows_uploaded_total",
             "dtpu_mirror_shard_bytes_uploaded_total",
             "dtpu_mirror_shard_full_packs_total",
