@@ -996,7 +996,17 @@ def _sim_10k_once(seed: int, native: bool | None = None):
     )
     if sim.state.native is not None:
         report["native"] = sim.state.native.counters()
-    return report, sim.digest()
+    digest = sim.digest()
+    # quiesce-clean proof at the 1M-task scale (docs/observability.md
+    # "State census & retention"): release everything, drain, require
+    # zero retained TaskStates and zero non-allowlisted residue across
+    # the scheduler + all 10k worker censuses — the bounded-memory
+    # oracle the ROADMAP 5(b) fuzzer asserts.  AFTER digest capture:
+    # the teardown cascade folds into the running digest.
+    from distributed_tpu.sim.validate import check_census_clean
+
+    report["census"] = check_census_clean(sim)
+    return report, digest
 
 
 def cfg_sim_10k():
@@ -1047,6 +1057,8 @@ def cfg_sim_10k():
         "events": rep1["events"],
         "digest": digest1,
         "deterministic": True,
+        # the 1M-task quiesce-clean proof (both runs pass or raise)
+        "census": rep1["census"],
         "host_canary_ms": _host_canary_ms(),
     }
 
@@ -2697,6 +2709,154 @@ def _smoke_engine() -> dict:
     }
 
 
+async def _smoke_census_live() -> dict:
+    """Live half of the census gate: a real in-process cluster computes
+    keys, the client releases everything, and the run must QUIESCE
+    CENSUS-CLEAN on every role — zero non-allowlisted residue, every
+    walk-vs-counter audit green (diagnostics/census.py)."""
+    import asyncio
+
+    from distributed_tpu import config as dtpu_config
+    from distributed_tpu.client.client import Client
+    from distributed_tpu.deploy.local import LocalCluster
+
+    with dtpu_config.set({"scheduler.jax.enabled": False}):
+        async with LocalCluster(n_workers=2, threads_per_worker=1) as cluster:
+            async with Client(cluster.scheduler_address) as c:
+                futs = c.map(_inc, range(64))
+                res = await c.gather(futs)
+                assert res == list(range(1, 65)), res[:5]
+                for f in futs:
+                    f.release()
+                del futs
+                s = cluster.scheduler.state
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    if not s.tasks and s.census.quiesced() and all(
+                        not w.state.tasks for w in cluster.workers
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                assert s.census.quiesced(), {
+                    m: s.census.families[m].probe() for m in s.census.motion
+                }
+                censuses = [("scheduler", s.census)] + [
+                    (w.address, w.state.census) for w in cluster.workers
+                ]
+                n_fam = 0
+                for who, census in censuses:
+                    census.audit()
+                    residue = census.residue()
+                    assert not residue, (who, census.enrich_findings(residue))
+                    n_fam += len(census.families)
+                # the RPC twin serves the same truth
+                recs = await c.scheduler.get_census(deep=True)
+                head = recs[0]
+                assert head["quiesced"] is True, head
+    return {"censuses": len(censuses), "families": n_fam}
+
+
+def _smoke_census() -> dict:
+    """State-census gate (diagnostics/census.py; docs/observability.md
+    "State census & retention"):
+
+    - census-on (sentinel ticking every flood round — a strict
+      over-approximation of the 2s production cadence) vs census-off
+      engine floods stay under the 5% budget by the min-per-pair-ratio
+      estimator;
+    - sentinel ticks are allocation-free (``sys.getallocatedblocks``
+      over a 20k-tick burst);
+    - a live run-then-quiesce LocalCluster ends census-clean on every
+      role, and the walk-vs-counter audits pass throughout.
+    """
+    import asyncio
+    import sys as _sys
+
+    from distributed_tpu.diagnostics.census import RetentionSentinel
+    from distributed_tpu.graph.spec import TaskSpec
+    from distributed_tpu.scheduler.state import SchedulerState
+
+    N_WORKERS, N_TASKS, REPS = 16, 2000, 7
+
+    def build():
+        state = SchedulerState(validate=False)
+        for i in range(N_WORKERS):
+            state.add_worker_state(
+                f"tcp://census:{i}", nthreads=2, memory_limit=2**30,
+                name=f"c{i}",
+            )
+        tasks = {f"cns-{i}": TaskSpec(_inc, (i,)) for i in range(N_TASKS)}
+        state.update_graph_core(
+            tasks, {k: set() for k in tasks}, list(tasks),
+            client="smoke", stimulus_id="smoke-census-graph",
+        )
+        return state
+
+    def flood(state, sentinel) -> float:
+        t0 = time.perf_counter()
+        rounds = 0
+        while True:
+            batch = [
+                (ts.key, ws.address, f"smk-cns-{ts.key}", {"nbytes": 8})
+                for ws in state.workers.values()
+                for ts in list(ws.processing)
+            ]
+            if not batch:
+                break
+            state.stimulus_tasks_finished_batch(batch)
+            if sentinel is not None:
+                sentinel.tick()
+            rounds += 1
+            assert rounds < 10 * N_TASKS, "flood did not converge"
+        return time.perf_counter() - t0
+
+    def arm(on: bool) -> float:
+        state = build()
+        sentinel = RetentionSentinel(state.census) if on else None
+        return flood(state, sentinel)
+
+    arm(True)   # untimed warmup (allocator/code warmup)
+    arm(False)
+    on_walls, off_walls = [], []
+    for _ in range(REPS):
+        on_walls.append(arm(True))
+        off_walls.append(arm(False))
+    min_ratio = min(on / off for on, off in zip(on_walls, off_walls))
+    overhead_pct = max(0.0, (min_ratio - 1.0) * 100)
+    assert overhead_pct < 5.0, (
+        f"census-on overhead {overhead_pct:.1f}% exceeds the 5% budget "
+        f"(on={on_walls}, off={off_walls})"
+    )
+
+    # allocation contract: the sentinel tick (every cheap probe + the
+    # slope folds) allocates nothing in steady state
+    state = build()
+    sentinel = RetentionSentinel(state.census)
+    for _ in range(64):
+        sentinel.tick()  # warm per-family floats + probe code paths
+    b0 = _sys.getallocatedblocks()
+    for _ in range(20_000):
+        sentinel.tick()
+    alloc_delta = _sys.getallocatedblocks() - b0
+    assert alloc_delta < 50, (
+        f"sentinel tick allocated ({alloc_delta} blocks over 20k ticks)"
+    )
+
+    live = asyncio.run(_smoke_census_live())
+    return {
+        "n_workers": N_WORKERS,
+        "n_tasks": N_TASKS,
+        "census_on_s": [round(w, 3) for w in on_walls],
+        "census_off_s": [round(w, 3) for w in off_walls],
+        "overhead_pct": round(overhead_pct, 2),
+        "alloc_delta_blocks": alloc_delta,
+        "live_clean": True,
+        "live_censuses": live["censuses"],
+        "live_families": live["families"],
+        "host_canary_ms": _host_canary_ms(),
+    }
+
+
 def run_smoke(only: str | None = None):
     """``python bench.py --smoke [name]``: tiny CPU-pinned configs; one
     JSON line on stdout; raises (non-zero exit) on any failure.  With a
@@ -2731,6 +2891,7 @@ def run_smoke(only: str | None = None):
         "engine": lambda: retry_once(_smoke_engine),
         "sim": _smoke_sim,
         "restart": lambda: retry_once(_smoke_restart),
+        "census": lambda: retry_once(_smoke_census),
         # "mesh" LAST on purpose: the sharded programs spin up the
         # 8-device XLA runtime (one thread pool per virtual device on a
         # 2-core box) and that background churn measurably widens the

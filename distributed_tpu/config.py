@@ -134,6 +134,22 @@ defaults: dict[str, Any] = {
             "journal": False,
             "journal-size": 65536,    # stimulus records kept in record mode
         },
+        # state census + retention sentinel (diagnostics/census.py;
+        # docs/observability.md "State census & retention").  Shared by
+        # both roles like the trace subtree; `enabled` gates only the
+        # periodic sentinel tick — the census registry itself is always
+        # built (the registration-completeness gate depends on it).
+        "census": {
+            "enabled": True,
+            "interval": "2s",         # sentinel tick cadence
+            # sustained growth (members/second EWMA) beyond this flags
+            # a family as leaking (one flight-recorder `leak` event per
+            # episode)
+            "slope-threshold": 50.0,
+            # families below this resident count never flag (noise
+            # floor: a bounded warm-up is not a leak)
+            "min-count": 1000,
+        },
         # control-plane self-profiling (diagnostics/selfprofile.py;
         # docs/observability.md "Self-profiling").  Shared by both
         # roles, like the trace subtree: the worker's event loop reads

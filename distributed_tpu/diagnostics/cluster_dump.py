@@ -52,6 +52,14 @@ class DumpArtefact:
         led = sched.get("ledger") or {}
         self.ledger: list = list(led.get("rows") or [])
         self.ledger_summary: dict = dict(led.get("summary") or {})
+        # state census (diagnostics/census.py): the scheduler's deep
+        # snapshot + every worker's, shipped in the dump by default
+        self.census: list = list(sched.get("census") or [])
+        self.worker_census: dict[str, list] = {
+            addr: list(recs)
+            for addr, recs in (self.state.get("worker_census") or {}).items()
+            if isinstance(recs, list)
+        }
         self._critical_path_precomputed: dict | None = (
             dict(led["critical_path"]) if led.get("critical_path") else None
         )
@@ -179,6 +187,33 @@ class DumpArtefact:
             for k, t in self.tasks.items()
         }
         return critical_path(self.ledger, deps)
+
+    def census_counts(self, node: str | None = None) -> dict[str, int]:
+        """Per-family resident counts from the dump's census section
+        (``node=None`` = the scheduler's; a worker address selects that
+        node's) — the post-mortem twin of the live ``/census`` route."""
+        recs = (
+            self.census if node is None
+            else self.worker_census.get(node, [])
+        )
+        return {
+            r["family"]: r.get("count", 0)
+            for r in recs
+            if r.get("type") == "census"
+        }
+
+    def census_findings(self) -> list[dict]:
+        """Every recorded retention finding across the dump — scheduler
+        and workers (family, count, member sample, referrer-derived
+        holder chain)."""
+        out = [
+            r for r in self.census if r.get("type") == "census-finding"
+        ]
+        for recs in self.worker_census.values():
+            out.extend(
+                r for r in recs if r.get("type") == "census-finding"
+            )
+        return out
 
     def workers_summary(self) -> dict[str, dict]:
         return {

@@ -61,6 +61,7 @@ _TRACKS = {
     "wstim": (6, "worker stimuli"),
     "shadow": (7, "shadow cost model (divergence samples)"),
     "stall": (8, "loop stalls (watchdog captures)"),
+    "leak": (12, "leaks (retention sentinel flags)"),
 }
 _OTHER_TRACK = (9, "other")
 _LEDGER_TRACK = (10, "ledger (decision joins)")
@@ -69,7 +70,8 @@ _CP_TRACK = (11, "critical path")
 
 def to_perfetto(events: Iterable[dict],
                 telemetry: Iterable[dict] | None = None,
-                ledger: Iterable[dict] | None = None) -> dict:
+                ledger: Iterable[dict] | None = None,
+                census: Iterable[dict] | None = None) -> dict:
     """Chrome ``trace_event`` JSON (the "JSON Array Format" with
     metadata) from flight-recorder events.  Timestamps are the ring's
     monotonic seconds scaled to microseconds — absolute values are
@@ -83,7 +85,14 @@ def to_perfetto(events: Iterable[dict],
     ``shadow`` ring events additionally feed a "costmodel divergence
     ratio" counter track (their ``n`` is the ratio in permille), so
     the decisions the constants are lying about are visible as spikes
-    next to the engine passes that made them."""
+    next to the engine passes that made them.
+
+    ``census`` (optional) takes ``/census`` JSONL records
+    (diagnostics/census.py) — per-family resident counts stamped with
+    the same monotonic clock — and renders one ``census <family>``
+    COUNTER track per family, so a family growing without bound plots
+    right next to the stimulus traffic that grew it (the sentinel's
+    ``leak`` ring events mark the flag instants on their own track)."""
     events = list(events)
     for ev in events:
         v = ev.get("v", TRACE_SCHEMA_VERSION)
@@ -184,6 +193,19 @@ def to_perfetto(events: Iterable[dict],
                     "args": {"ms": float(rec.get("rtt", 0.0)) * 1e3},
                 }
             )
+    for rec in census or ():
+        if rec.get("type") != "census":
+            continue
+        trace_events.append(
+            {
+                "name": f"census {rec.get('family', '?')}",
+                "ph": "C",
+                "ts": float(rec.get("ts", 0.0)) * 1e6,
+                "pid": 0,
+                "tid": 0,
+                "args": {"count": int(rec.get("count", 0))},
+            }
+        )
     for rec in ledger or ():
         kind = rec.get("type")
         if kind == "ledger-row":
@@ -530,13 +552,12 @@ def replay_stimulus_trace(state: Any, records: Iterable[dict],
             steal = (state.extensions or {}).get("stealing")
             if steal is not None:
                 info = steal.in_flight.pop(payload.get("key", ""), None)
-                if info is not None and payload.get("matched"):
-                    steal.in_flight_occupancy[info.thief] -= info.thief_duration
-                    steal.in_flight_occupancy[info.victim] += info.victim_duration
-                    steal.in_flight_tasks[info.victim] -= 1
-                    if not steal.in_flight:
-                        steal.in_flight_occupancy.clear()
-                        steal._in_flight_event.set()
+                if info is not None:
+                    # matched or not, a consumed window reverts its
+                    # overlays — the ONE revert move_task_confirm uses
+                    # (WorkStealing._revert_in_flight), so a replayed
+                    # scheduler's overlay rows match the live one's
+                    steal._revert_in_flight(info)
         elif op == "transitions":
             flush()
             merge(
@@ -652,6 +673,13 @@ def main(argv: list[str] | None = None) -> int:
              "critical-path track joined to the stimulus swimlanes",
     )
     parser.add_argument(
+        "--census", metavar="SRC",
+        help="also render state-census JSONL (file path or http URL: "
+             "the /census route or a dumped census section) as one "
+             "counter track per container family next to the stimulus "
+             "timeline (diagnostics/census.py)",
+    )
+    parser.add_argument(
         "--jsonl", metavar="OUT",
         help="re-emit the (possibly url-fetched) events as JSONL to OUT",
     )
@@ -681,6 +709,9 @@ def main(argv: list[str] | None = None) -> int:
     ledger = None
     if args.ledger:
         ledger = _read_jsonl_source(args.ledger)
+    census = None
+    if args.census:
+        census = _read_jsonl_source(args.census)
 
     wrote = False
     if args.speedscope:
@@ -711,7 +742,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.perfetto:
         with open(args.perfetto, "w") as f:
             json.dump(
-                to_perfetto(events, telemetry=telemetry, ledger=ledger),
+                to_perfetto(events, telemetry=telemetry, ledger=ledger,
+                            census=census),
                 f,
             )
         print(f"wrote {len(events)} events to {args.perfetto}")

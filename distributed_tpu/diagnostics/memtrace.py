@@ -21,20 +21,45 @@ from __future__ import annotations
 import tracemalloc
 from typing import Any
 
+#: owners (server ids) that asked for tracing and have not stopped it.
+#: tracemalloc itself is PROCESS-global — with in-process workers
+#: (LocalCluster) a bare stop on one worker used to kill the trace for
+#: every server in the process.  start/stop are refcounted per OWNER:
+#: the underlying trace only stops when the LAST owner stops.  A bare
+#: (ownerless) start/stop pair uses the "" owner, preserving the old
+#: single-caller semantics.
+_owners: set[str] = set()
+#: True only when THIS module called tracemalloc.start(): a trace the
+#: user armed themselves (PYTHONTRACEMALLOC, their own start()) is
+#: never ours to stop, no matter what the owner set does
+_started_here = False
 
-def start_trace(nframes: int = 5) -> dict:
-    """Begin tracing allocations in this process (idempotent)."""
+
+def start_trace(nframes: int = 5, owner: str = "") -> dict:
+    """Begin tracing allocations in this process (idempotent per
+    owner)."""
+    global _started_here
+    _owners.add(owner)
     if not tracemalloc.is_tracing():
         tracemalloc.start(nframes)
-    return {"status": "OK", "tracing": True}
+        _started_here = True
+    return {"status": "OK", "tracing": True, "owners": len(_owners)}
 
 
-def stop_trace() -> dict:
-    """Stop tracing.  PROCESS-global: with in-process workers, stopping
-    on one worker stops it for every server in the process."""
-    if tracemalloc.is_tracing():
+def stop_trace(owner: str = "") -> dict:
+    """Release this owner's hold on the trace; the process-global
+    tracemalloc stops only when no owner remains AND this module
+    started it (an externally-armed trace is left alone)."""
+    global _started_here
+    _owners.discard(owner)
+    if not _owners and _started_here and tracemalloc.is_tracing():
         tracemalloc.stop()
-    return {"status": "OK", "tracing": False}
+        _started_here = False
+    return {
+        "status": "OK",
+        "tracing": tracemalloc.is_tracing(),
+        "owners": len(_owners),
+    }
 
 
 def report(top_n: int = 10, group_by: str = "lineno") -> dict:

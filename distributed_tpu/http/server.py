@@ -270,6 +270,82 @@ def selfprofile_metric_lines(wall: Any, profiler: Any = None,
     return lines
 
 
+def census_metric_lines(census: Any) -> list[str]:
+    """``dtpu_census_*`` exposition (diagnostics/census.py;
+    docs/observability.md "State census & retention"): per-family
+    resident counts + sentinel growth slopes for the cheap (O(1))
+    families, quiesce state, audit health, and leak-finding counters —
+    the live answer to "what are we still holding"."""
+    lines = [
+        prom_line(
+            "dtpu_census_families", len(census.families),
+            help_="Container families registered with the state census",
+            type_="gauge",
+        ),
+        prom_line(
+            "dtpu_census_quiesced", 1 if census.quiesced() else 0,
+            help_="1 when every census motion family reads zero "
+                  "(no tasks, nothing in flight)",
+            type_="gauge",
+        ),
+        prom_line(
+            "dtpu_census_audits_total", census.audits,
+            help_="Walk-vs-counter census audits run",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_census_audit_failures_total", census.audit_failures,
+            help_="Census audits that found counter/walk drift",
+            type_="counter",
+        ),
+        prom_line(
+            "dtpu_census_findings_total", census.findings_total,
+            help_="Non-allowlisted residue findings recorded at quiesce",
+            type_="counter",
+        ),
+    ]
+    sent = census.sentinel
+    lines.append(
+        prom_line(
+            "dtpu_census_leaks_flagged_total",
+            sent.leaks_flagged if sent is not None else 0,
+            help_="Census families flagged by the retention sentinel's "
+                  "growth-slope EWMA",
+            type_="counter",
+        )
+    )
+    first = True
+    for name, fam in census.families.items():
+        if fam.cost != "o1":
+            continue
+        lines.append(
+            prom_line(
+                "dtpu_census_count", fam.probe(), {"family": name},
+                help_="Resident members per census family (cheap "
+                      "families only; walk families via the get_census "
+                      "RPC deep=True or cluster dumps)"
+                if first else None,
+                type_="gauge",
+            )
+        )
+        first = False
+    first = True
+    for name, fam in census.families.items():
+        if fam.cost != "o1":
+            continue
+        lines.append(
+            prom_line(
+                "dtpu_census_growth_per_s", round(fam.slope, 3),
+                {"family": name},
+                help_="Sentinel EWMA of members/second growth per "
+                      "census family" if first else None,
+                type_="gauge",
+            )
+        )
+        first = False
+    return lines
+
+
 #: computed once per process: the constant identity labels never change
 _BUILD_INFO_CACHE: dict[str, str] = {}
 
@@ -854,6 +930,7 @@ def scheduler_metrics(scheduler: Any) -> bytes:
         lines.extend(prom_histogram_lines(name, hist, help_=help_))
     lines.extend(cluster_telemetry_metric_lines(s.telemetry))
     lines.extend(ledger_metric_lines(s.ledger))
+    lines.extend(census_metric_lines(s.census))
     lines.extend(trace_metric_lines(s.trace))
     lines.extend(
         selfprofile_metric_lines(
@@ -891,6 +968,7 @@ def worker_metrics(worker: Any) -> bytes:
         )
         lines.append(prom_line("dtpu_worker_spill_bytes", data.slow_bytes))
     lines.extend(telemetry_metric_lines(worker.telemetry))
+    lines.extend(census_metric_lines(st.census))
     lines.extend(trace_metric_lines(st.trace))
     lines.extend(
         selfprofile_metric_lines(

@@ -1180,11 +1180,19 @@ class NativeEngine:
                 elif op == OP_META:
                     # misrouted completion for a still-processing task:
                     # the oracle pops metadata BEFORE the arm's worker
-                    # guard drops the event — replay exactly that
+                    # guard answers free-keys — replay both (the
+                    # reporter's unaccounted copy must drop, or it
+                    # outlives the task; see
+                    # _transition_processing_memory's fence)
                     ts = rows[t_a[j]]
                     key, worker, cur_stim, kwargs = events[t_c[j]]
                     ts.metadata = kwargs.pop("metadata", None) \
                         or ts.metadata
+                    worker_msgs.setdefault(worker, []).append({
+                        "op": "free-keys",
+                        "keys": [key],
+                        "stimulus_id": cur_stim,
+                    })
         if n == 0:
             return  # no arms ran: nothing touched, totals unchanged
         # occupancy write-back for every touched worker (python reads

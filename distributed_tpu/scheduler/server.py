@@ -208,6 +208,7 @@ class Scheduler(Server):
             "get_cluster_state": self.get_cluster_state,
             "get_telemetry": self.get_telemetry,
             "get_ledger": self.get_ledger,
+            "get_census": self.get_census,
             "get_runspec": self.get_runspec,
             "versions": self.versions,
             "worker_versions": self.worker_versions,
@@ -407,6 +408,47 @@ class Scheduler(Server):
                 self.watchdog.tick, self.watchdog.interval
             )
             self.watchdog.start(loop_ident)
+        # retention sentinel over the state census (diagnostics/
+        # census.py; docs/observability.md "State census & retention"):
+        # a low-cadence tick folds per-family growth slopes and runs
+        # the census-vs-empty diff on every quiesce edge.  Fresh
+        # findings get their bounded gc.get_referrers holder sample
+        # OFF the loop.  The durability dirty sets are exempt from
+        # LIVE quiesce diffs only — they drain on snapshot cadence
+        # (the sim/bench teardown gates snapshot first and exempt
+        # nothing).
+        if config.get("scheduler.census.enabled", True):
+            from distributed_tpu.diagnostics.census import RetentionSentinel
+
+            census = self.state.census
+            census.sentinel = sentinel = RetentionSentinel(
+                census, trace=self.trace,
+                quiesce_allow=(
+                    "durability.dirty-tasks", "durability.removed-tasks",
+                    "durability.dirty-workers", "durability.removed-workers",
+                ),
+            )
+
+            def _enriched(fut: Any) -> None:
+                exc = fut.exception()
+                if exc is not None:
+                    logger.warning(
+                        "census finding enrichment failed: %r", exc
+                    )
+
+            def _census_tick() -> None:
+                fresh = sentinel.tick()
+                if fresh:
+                    asyncio.get_running_loop().run_in_executor(
+                        None, census.enrich_findings, fresh
+                    ).add_done_callback(_enriched)
+
+            self.periodic_callbacks["census-sentinel"] = PeriodicCallback(
+                _census_tick,
+                config.parse_timedelta(
+                    config.get("scheduler.census.interval")
+                ),
+            )
         if self._http_port is not None:
             from distributed_tpu.diagnostics.selfprofile import profile_jsonl
             from distributed_tpu.http.dashboard import json_api_routes
@@ -447,6 +489,14 @@ class Scheduler(Server):
                     # docs/observability.md "Decision ledger")
                     "/ledger": lambda: (
                         to_jsonl(self.state.ledger.snapshot()),
+                        "application/x-ndjson",
+                    ),
+                    # state census: per-family resident counts + recent
+                    # findings as JSONL (cheap families; the get_census
+                    # RPC adds the O(n) walk families on demand —
+                    # diagnostics/census.py, docs/observability.md)
+                    "/census": lambda: (
+                        to_jsonl(self.state.census.snapshot()),
                         "application/x-ndjson",
                     ),
                     **json_api_routes(self),
@@ -990,7 +1040,20 @@ class Scheduler(Server):
         if tel.enabled:
             with self.state.wall.phase("telemetry.fold"):
                 if link_telemetry:
-                    tel.fold_rows(link_telemetry, reporter=address)
+                    # fold only links between CURRENTLY registered
+                    # workers: a row naming a peer that already left
+                    # (or never completed registration) would re-create
+                    # a LinkStats entry forget_worker(PR 7) just pruned
+                    # — nothing re-prunes it, so with worker churn the
+                    # link table grew without bound (the census's
+                    # telemetry.links.stale family walks this to zero)
+                    workers = self.state.workers
+                    rows = [
+                        r for r in link_telemetry
+                        if r[0] in workers and r[1] in workers
+                    ]
+                    if rows:
+                        tel.fold_rows(rows, reporter=address)
                 if rtt:
                     tel.record_rtt(address, rtt)
                 if fine_metrics:
@@ -2062,6 +2125,15 @@ class Scheduler(Server):
         docs/observability.md "Decision ledger & critical-path")."""
         return self.state.ledger.snapshot(n)
 
+    async def get_census(self, deep: bool = False) -> list[dict]:
+        """The state census (head + per-family records + recent
+        findings): the RPC twin of the HTTP ``/census`` route
+        (diagnostics/census.py; docs/observability.md "State census &
+        retention").  ``deep=True`` adds the O(n) walk families — the
+        relation-set edge counts — and is meant for quiesced or
+        dump-time use, not a per-second poll."""
+        return self.state.census.snapshot(deep=deep)
+
     async def get_cluster_state(self, exclude: list[str] | None = None) -> dict:
         """Debug dump of the whole cluster (reference scheduler.py:3964)."""
         s = self.state
@@ -2163,7 +2235,17 @@ class Scheduler(Server):
                 prof["stalls_total"] = self.watchdog.stalls_total
                 prof["stalls"] = list(self.watchdog.stalls)
             scheduler_info["profile"] = prof
+        if "census" not in (exclude or ()):
+            # the state census travels with the dump (deep = relation
+            # walks included): a post-mortem can see exactly what the
+            # control plane was still holding, with any recorded
+            # retention findings (diagnostics/census.py)
+            scheduler_info["census"] = s.census.snapshot(deep=True)
         out = {"scheduler": scheduler_info}
+        if "census" not in (exclude or ()):
+            out["worker_census"] = await self.broadcast(
+                msg={"op": "get_census", "deep": True}
+            )
         if "flight_recorder" not in (exclude or ()):
             # every node's causal tail ships in the dump by default
             # (bounded, JSON-safe): chaos post-mortems can join the

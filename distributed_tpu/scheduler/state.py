@@ -42,6 +42,7 @@ from distributed_tpu.exceptions import (
     NoValidWorkerError,
     TransitionCounterMaxExceeded,
 )
+from distributed_tpu.diagnostics.census import build_scheduler_census
 from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.graph.spec import TaskSpec
 from distributed_tpu.ledger import DecisionLedger
@@ -595,7 +596,12 @@ class SchedulerState:
         }
 
         self.total_nthreads = 0
-        self.total_nthreads_history: list[tuple[float, int]] = [(self.clock(), 0)]
+        # bounded: one row per fleet-capacity flip — as a plain list
+        # this grew forever under autoscaling churn (census-found; the
+        # reference keeps the same unbounded list)
+        self.total_nthreads_history: deque[tuple[float, int]] = deque(
+            [(self.clock(), 0)], maxlen=4096
+        )
         self._total_occupancy = 0.0
         self.n_tasks = 0
         self.plugins: dict[str, Any] = {}
@@ -638,6 +644,13 @@ class SchedulerState:
         self.event_counts: defaultdict[str, int] = defaultdict(int)
         self.task_metadata: dict = {}
         self.unknown_durations: dict[str, set[TaskState]] = {}
+        # state census (diagnostics/census.py; docs/observability.md
+        # "State census & retention"): typed inventory of every
+        # long-lived container above — built LAST so every probe
+        # closure sees the final containers.  Registration is the
+        # contract: a new container attribute must be census-registered
+        # or allowlisted with a reason (tests/test_census.py).
+        self.census = build_scheduler_census(self)
 
     # ------------------------------------------------------------------ misc
 
@@ -1239,9 +1252,20 @@ class SchedulerState:
         assert worker
         ws = ts.processing_on
         if ws is None or ws.address != worker or self.workers.get(worker) is not ws:
-            # stale or misrouted completion: ignore (reference scheduler.py:2380)
+            # stale or misrouted completion (reference scheduler.py:2380
+            # ignores it outright).  The reporter computed a value this
+            # scheduler will never account — an overtaken steal victim,
+            # or a pre-partition assignment finishing after the key was
+            # re-placed.  Without an answer the reporter holds task +
+            # data FOREVER (the forget-time free-keys only reaches
+            # who_has members): tell it to drop the unaccounted copy.
+            # The native engine's OP_META tape row replays the same
+            # message (scheduler/native_engine.py).
             logger.debug("Unexpected finished task %s from %s", key, worker)
-            return {}, {}, {}
+            return {}, {}, {worker: [{
+                "op": "free-keys", "keys": [key],
+                "stimulus_id": stimulus_id,
+            }]}
         wws = ws
 
         # update duration statistics (reference scheduler.py:2366 + _observe)
@@ -3022,13 +3046,27 @@ class SchedulerState:
         ws = self.workers.get(errant_worker)
         if ts is None:
             return {}, {}
+        worker_msgs: dict = {}
         if ws is not None and ws in ts.who_has:
             self.remove_replica(ts, ws)
+            # the replica model is authoritative: once this copy is
+            # written off, tell the errant worker to drop it too.  If
+            # the report was right this is a no-op; if the serve merely
+            # FAILED (a partition) the holder would otherwise keep a
+            # replica the scheduler no longer tracks — free-keys at
+            # forget only reaches who_has members, so the orphan
+            # outlives the task forever (census-found: partition chaos
+            # left scheduler-untracked memory keys on healed workers)
+            worker_msgs[errant_worker] = [{
+                "op": "remove-replicas", "keys": [key],
+                "stimulus_id": stimulus_id,
+            }]
         if not ts.who_has:
             # see stimulus_reschedule: self-journaled, so the round must
             # not journal again
-            return self._transitions_observed({key: "released"}, stimulus_id)
-        return {}, {}
+            cm, wm = self._transitions_observed({key: "released"}, stimulus_id)
+            return cm, _merge_msgs(worker_msgs, wm)
+        return {}, worker_msgs
 
     def stimulus_request_refresh_who_has(
         self, keys: Iterable[Key], worker: str, stimulus_id: str
@@ -3249,6 +3287,15 @@ class SchedulerState:
             self.placement.on_remove_worker(self, ws)
         # tasks parked for the dead worker become globally poppable again
         self.splice_parked(address)
+        # drop group co-assignment cursors pointing at the dead worker:
+        # decide_worker re-validates membership before using one, so
+        # this is behavior-neutral — but the stale reference pinned the
+        # whole removed WorkerState object per group (census-found;
+        # removals are rare, O(groups) is fine here)
+        for tg in self.task_groups.values():
+            if tg.last_worker is ws:
+                tg.last_worker = None
+                tg.last_worker_tasks_left = 0
 
         recommendations: dict[Key, str] = {}
         client_msgs: dict = {}
@@ -3451,6 +3498,7 @@ class SchedulerState:
             computation = Computation(self.clock())
             self.computations.append(computation)
         touched: list[TaskState] = []
+        created: list[TaskState] = []
         for key, spec in tasks.items():
             ts = self.tasks.get(key)
             fresh = False
@@ -3460,6 +3508,7 @@ class SchedulerState:
                 # whole pooled receive buffer it arrived in (docs/wire.md)
                 ts = self.new_task(key, compact_frames(spec), "released")
                 fresh = spec is not None
+                created.append(ts)
             elif ts.run_spec is None and spec is not None:
                 ts.run_spec = compact_frames(spec)
                 fresh = True
@@ -3581,6 +3630,29 @@ class SchedulerState:
         client_msgs, worker_msgs = self._transitions_observed(
             recommendations, stimulus_id
         )
+        # cull unreachable junk at ingest: a task CREATED by this batch
+        # that no requested key transitively needs, nothing depends on
+        # and no client wants would otherwise sit released forever (the
+        # reference relies on client-side culling; at millions-of-users
+        # scale a buggy client must not grow the scheduler without
+        # bound — found by the state census's quiesce gate).  A second
+        # engine round, deliberately: released->forgotten is an
+        # uncompiled edge, and folding it into the round above would
+        # bounce the WHOLE wanted-set drain off the native engine.
+        cull: dict[Key, str] = {}
+        for ts in created:
+            if (
+                ts not in wanted
+                and ts.state == "released"
+                and not ts.dependents
+                and not ts.who_wants
+                and not ts.waiters
+            ):
+                cull[ts.key] = "forgotten"
+        if cull:
+            cm2, wm2 = self._transitions_observed(cull, stimulus_id)
+            client_msgs = _merge_msgs(client_msgs, cm2)
+            worker_msgs = _merge_msgs(worker_msgs, wm2)
         # immediately report already-completed keys
         for key in keys:
             ts = self.tasks.get(key)

@@ -134,6 +134,17 @@ class WorkStealing:
 
     def remove_worker(self, scheduler: Any, address: str) -> None:
         self.stealable.pop(address, None)
+        # drop the departed worker's overlay + metric rows NOW: with
+        # steals continuously in flight the bulk clear in
+        # _revert_in_flight never runs, and the defaultdicts otherwise
+        # retain one row per ever-removed WorkerState — a dead row
+        # could even scatter onto a reused mirror slot (census-found,
+        # tests/test_census.py)
+        for d in (self.in_flight_occupancy, self.in_flight_tasks):
+            for ws in [w for w in d if w.address == address]:
+                del d[ws]
+        for m in self.metrics.values():
+            m.pop(address, None)
 
     # Tape-safe plugin contract (scheduler/native_engine.py): the
     # native engine's applier replays ``transition`` per tape row in
@@ -158,12 +169,7 @@ class WorkStealing:
                 self.remove_key_from_stealable(ts)
             info = self.in_flight.pop(key, None)
             if info is not None:
-                self.in_flight_occupancy[info.thief] -= info.thief_duration
-                self.in_flight_occupancy[info.victim] += info.victim_duration
-                self.in_flight_tasks[info.victim] -= 1
-                if not self.in_flight:
-                    self.in_flight_occupancy.clear()
-                    self._in_flight_event.set()
+                self._revert_in_flight(info)
 
     # ----------------------------------------------------- stealable index
 
@@ -216,6 +222,34 @@ class WorkStealing:
             levels[level].discard(ts)
 
     # ------------------------------------------------------- move protocol
+
+    def _revert_in_flight(self, info: "InFlightInfo") -> None:
+        """Close one confirm window's occupancy/task-count overlays —
+        the ONE revert shared by the transition hook (task left
+        processing mid-steal) and move_task_confirm.  Overlay rows for
+        workers that were removed while the window was open are NOT
+        recreated (the defaultdict write would resurrect a dead
+        WorkerState's row forever), integer task counts delete at zero,
+        and the bulk clear still runs whenever the last window closes
+        (float overlay drift never outlives an idle balancer)."""
+        occ = self.in_flight_occupancy
+        counts = self.in_flight_tasks
+        workers = self.state.workers
+        thief, victim = info.thief, info.victim
+        if thief in occ or workers.get(thief.address) is thief:
+            occ[thief] -= info.thief_duration
+        if victim in occ or workers.get(victim.address) is victim:
+            occ[victim] += info.victim_duration
+        left = counts.get(victim)
+        if left is not None:
+            if left <= 1:
+                del counts[victim]
+            else:
+                counts[victim] = left - 1
+        if not self.in_flight:
+            occ.clear()
+            counts.clear()
+            self._in_flight_event.set()
 
     def seed_in_flight(self, ts: "TaskState", victim: "WorkerState",
                        thief: "WorkerState", victim_duration: float,
@@ -347,23 +381,24 @@ class WorkStealing:
             # replays the in_flight entry back to life (occupancy
             # overlays included) and the bounced scheduler's next
             # balance cycle diverges from the unbounced twin.  matched
-            # mirrors the stimulus fence: a mismatched answer consumes
-            # the window but must not revert overlays (exactly the live
-            # semantics below).
+            # mirrors the stimulus fence for the MOVE only: matched or
+            # not, a consumed window always reverts its overlays (the
+            # live semantics below; replay_stimulus_trace calls the
+            # same _revert_in_flight).
             self.state.trace.record(
                 "steal-confirm",
                 {"key": key, "matched": info.stimulus_id == stimulus_id},
                 stimulus_id,
             )
         if info.stimulus_id != stimulus_id:
+            # a mismatched (stale/forged) answer still CONSUMED the
+            # window: revert the overlays too, or the skew — and the
+            # dead defaultdict rows carrying it — outlive the steal
+            # forever (found by the poison-flood census gate)
+            self._revert_in_flight(info)
             return
         victim, thief = info.victim, info.thief
-        self.in_flight_occupancy[thief] -= info.thief_duration
-        self.in_flight_occupancy[victim] += info.victim_duration
-        self.in_flight_tasks[victim] -= 1
-        if not self.in_flight:
-            self.in_flight_occupancy.clear()
-            self._in_flight_event.set()
+        self._revert_in_flight(info)
 
         ts = self.state.tasks.get(key)
         if ts is None or ts.state != "processing" or ts.processing_on is not victim:
@@ -869,7 +904,10 @@ class WorkStealing:
             self.move_task_request(ts, victim, thief)
 
     def _combined_occupancy(self, ws: "WorkerState") -> float:
-        return ws.occupancy + self.in_flight_occupancy[ws]
+        # .get, NOT the defaultdict read: a [] miss here materialized a
+        # permanent 0.0 row per ever-priced worker (census-found — the
+        # overlay must only ever hold rows opened by seed_in_flight)
+        return ws.occupancy + self.in_flight_occupancy.get(ws, 0.0)
 
     def _get_thief(self, ts: "TaskState",
                    idle_workers: list) -> "WorkerState | None":

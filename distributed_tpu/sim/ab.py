@@ -51,6 +51,13 @@ def run_policy(
         if cp is not None
         else None
     )
+    # the retention half of the A/B contract: after digest + critical
+    # path are captured, release everything and require zero retained
+    # state — "zero lost keys AND zero retained state" holds for BOTH
+    # policy arms (sim/validate.check_census_clean raises otherwise)
+    from distributed_tpu.sim.validate import check_census_clean
+
+    report["census"] = check_census_clean(sim)
     return report
 
 

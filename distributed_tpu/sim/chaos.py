@@ -25,6 +25,7 @@ from typing import Any
 from distributed_tpu.sim.core import ClusterSim
 from distributed_tpu.sim.traces import SyntheticDag
 from distributed_tpu.sim.validate import (
+    check_census_clean,
     check_model_compliance,
     check_no_lost_keys,
     install_recorder,
@@ -50,7 +51,14 @@ def _finish(sim: ClusterSim, recorder, model: dict | None) -> dict:
     check_no_lost_keys(sim)
     if model is not None:
         check_model_compliance(sim, model, recorder)
+    # digest FIRST: the census gate releases the surviving keys and
+    # drains the forgetting cascade, which folds further transitions
+    # into the running digest — twin comparisons must use this
+    # pre-teardown value
     report["digest"] = sim.digest()
+    # the retention half of the invariant: zero lost keys AND zero
+    # retained state (docs/observability.md "State census & retention")
+    report["census"] = check_census_clean(sim)
     return report
 
 
@@ -116,6 +124,7 @@ def scenario_straggler(
     twin.straggler(list(twin.workers)[0], factor)
     twin.run()
     check_no_lost_keys(twin)
+    check_census_clean(twin)
     report["nosteal_makespan_s"] = twin.makespan
     if not (
         sim.makespan is not None
@@ -237,13 +246,18 @@ def scenario_scheduler_bounce(
     _base_trace(seed).start(twin)
     twin.run()
     check_no_lost_keys(twin)
-    if sim.digest() != twin.digest():
+    # compare digests BEFORE the twin's census teardown, against the
+    # bounced run's pre-teardown digest captured in _finish (the gate's
+    # release cascade folds into the running digest)
+    twin_digest = twin.digest()
+    if report["digest"] != twin_digest:
         raise AssertionError(
             "bounced run diverged from the unbounced same-seed twin: "
-            f"{sim.digest()} != {twin.digest()} (recovery is not "
+            f"{report['digest']} != {twin_digest} (recovery is not "
             "transparent)"
         )
-    report["twin_digest"] = twin.digest()
+    report["twin_digest"] = twin_digest
+    check_census_clean(twin)
     return sim, report
 
 

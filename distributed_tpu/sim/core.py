@@ -824,6 +824,28 @@ class ClusterSim:
             self._tick_amm()
         if self.find_missing_interval:
             self._tick_find_missing()
+        # DTPU_CENSUS_CHECK: run the walk-vs-counter census audits
+        # THROUGHOUT the run (scheduler + every alive worker) on the
+        # steal cadence, not only at the quiesce gate — the sim twin of
+        # the live sentinel's check mode (diagnostics/census.py)
+        if self.state.census.check:
+            self._tick_census_audit()
+
+    def _tick_census_audit(self) -> None:
+        if self.workload_done():
+            return
+        self.heap.at(
+            self.clock() + max(self.steal_interval or 0.05, 0.05),
+            self._run_census_audit,
+        )
+
+    def _run_census_audit(self) -> None:
+        self.state.census.audit()
+        for w in self.workers.values():
+            if w.alive:
+                w.state.census.audit()
+        self.counters["census_audits"] += 1
+        self._tick_census_audit()
 
     def _tick_steal(self) -> None:
         if self.workload_done():

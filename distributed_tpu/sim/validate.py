@@ -128,6 +128,66 @@ def check_model_compliance(sim: "ClusterSim", model: dict,
     )
 
 
+def check_census_clean(sim: "ClusterSim") -> dict:
+    """The retention half of the convergence contract ("zero lost keys
+    AND zero retained state", docs/observability.md "State census &
+    retention"): release every still-wanted key, drain the forgetting
+    cascade, then require
+
+    - every census walk-vs-counter audit to pass (scheduler + every
+      alive worker — the maintained counters may not have drifted at
+      ANY point, quiesce just makes the walk cheap);
+    - the scheduler census to report quiescent;
+    - zero non-allowlisted residue on the scheduler census and on
+      every alive worker's census.  Residue raises
+      :class:`~distributed_tpu.diagnostics.census.CensusResidueError`
+      with enriched findings (member sample + ``gc.get_referrers``
+      holder identification naming the retaining container).
+
+    With durability enabled, a final snapshot + journal flush runs
+    first — the dirty-set families drain by snapshot cadence, and the
+    teardown contract is "quiesce AFTER the final snapshot is clean".
+
+    Returns a summary dict for reports/benches.
+    """
+    from distributed_tpu.diagnostics.census import CensusResidueError
+
+    sim.release_keys(list(sim.keys_wanted))
+    sim.run()
+    if sim.durability is not None:
+        sim.durability.snapshot()
+        sim.durability.flush_journal()
+    state = sim.state
+    censuses = [state.census] + [
+        w.state.census for w in sim.workers.values() if w.alive
+    ]
+    audits = 0
+    findings: list[dict] = []
+    for c in censuses:
+        c.audit()
+        audits += 1
+        findings.extend(c.residue())
+    if not state.census.quiesced():
+        raise CensusResidueError(
+            "scheduler census does not report quiescent after release "
+            f"+ drain: motion={ {m: state.census.families[m].probe() for m in state.census.motion} }"
+        )
+    if findings:
+        for c in censuses:
+            c.enrich_findings(findings)
+        raise CensusResidueError(
+            f"{len(findings)} non-allowlisted census famil"
+            f"{'y' if len(findings) == 1 else 'ies'} retained state at "
+            f"quiesce: {findings}"
+        )
+    return {
+        "census_clean": True,
+        "censuses": len(censuses),
+        "audits": audits,
+        "families": sum(len(c.families) for c in censuses),
+    }
+
+
 def check_no_lost_keys(sim: "ClusterSim") -> None:
     """The convergence contract every chaos scenario asserts:
 

@@ -60,6 +60,9 @@ CATEGORIES = (
     "stall",       # the stall watchdog caught a blocked event loop
                    # (diagnostics/selfprofile.py — key = formatted
                    # traceback, name = in-progress phase, n = lag ms)
+    "leak",        # the retention sentinel flagged a census family
+                   # (diagnostics/census.py — name = family, n = its
+                   # resident member count at flag time)
 )
 
 

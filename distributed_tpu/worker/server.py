@@ -235,6 +235,7 @@ class Worker(Server):
             "plugin_add": self.plugin_add,
             "plugin_remove": self.plugin_remove,
             "get_telemetry": self.get_telemetry,
+            "get_census": self.get_census,
         }
         stream_handlers = {
             "compute-task": self._stream_compute_task,
@@ -374,6 +375,37 @@ class Worker(Server):
                 self.watchdog.tick, self.watchdog.interval
             )
             self.watchdog.start(loop_ident)
+        # retention sentinel over this worker's state census — same
+        # contract as the scheduler role (diagnostics/census.py;
+        # docs/observability.md "State census & retention")
+        if config.get("scheduler.census.enabled", True):
+            from distributed_tpu.diagnostics.census import RetentionSentinel
+
+            census = self.state.census
+            census.sentinel = sentinel = RetentionSentinel(
+                census, trace=self.trace,
+            )
+
+            def _enriched(fut) -> None:
+                exc = fut.exception()
+                if exc is not None:
+                    logger.warning(
+                        "census finding enrichment failed: %r", exc
+                    )
+
+            def _census_tick() -> None:
+                fresh = sentinel.tick()
+                if fresh:
+                    asyncio.get_running_loop().run_in_executor(
+                        None, census.enrich_findings, fresh
+                    ).add_done_callback(_enriched)
+
+            self.periodic_callbacks["census-sentinel"] = PeriodicCallback(
+                _census_tick,
+                config.parse_timedelta(
+                    config.get("scheduler.census.interval")
+                ),
+            )
         if self._http_port is not None:
             from distributed_tpu.diagnostics.selfprofile import profile_jsonl
             from distributed_tpu.tracing import to_jsonl
@@ -393,6 +425,13 @@ class Worker(Server):
                     # (telemetry.py; docs/observability.md)
                     "/telemetry": lambda: (
                         to_jsonl(self.telemetry.snapshot()),
+                        "application/x-ndjson",
+                    ),
+                    # state census: this worker's per-family resident
+                    # counts + findings (diagnostics/census.py;
+                    # docs/observability.md "State census & retention")
+                    "/census": lambda: (
+                        to_jsonl(self.state.census.snapshot()),
                         "application/x-ndjson",
                     ),
                     # control-plane self-profile (loop tree + wall
@@ -708,6 +747,13 @@ class Worker(Server):
             self.cp_profiler.stop()  # flushes the in-flight cycle
         self.executor.shutdown(wait=False)
         self.actor_executor.shutdown(wait=False)
+        # release any memory-trace hold this server owns: a worker
+        # closed mid-trace must not leave the process-global
+        # tracemalloc unstoppable (diagnostics/memtrace.py refcounts
+        # per owner; discard is a no-op when we never started one)
+        from distributed_tpu.diagnostics import memtrace
+
+        memtrace.stop_trace(owner=self.id)
         if hasattr(self.data, "close"):
             self.data.close()
         if self.http_server is not None:
@@ -785,6 +831,12 @@ class Worker(Server):
         """This node's telemetry snapshot (JSON-safe records): the RPC
         twin of the HTTP ``/telemetry`` route (telemetry.py)."""
         return self.telemetry.snapshot()
+
+    async def get_census(self, deep: bool = False) -> list[dict]:
+        """This worker's state census (head + per-family records +
+        findings): the RPC twin of the HTTP ``/census`` route
+        (diagnostics/census.py; docs/observability.md)."""
+        return self.state.census.snapshot(deep=deep)
 
     async def gather(self, who_has: dict[Key, list[str]] | None = None) -> dict:
         """Pull keys from peers into local memory (reference worker.py:1274)."""
@@ -885,13 +937,15 @@ class Worker(Server):
                                    top_n: int = 10) -> dict:
         """tracemalloc-backed memory introspection (the reference's
         memray role, diagnostics/memray.py:26): action = start | stop |
-        report."""
+        report.  start/stop are refcounted per server id: with
+        in-process workers (LocalCluster) one worker's stop no longer
+        kills the process-global trace for every other server."""
         from distributed_tpu.diagnostics import memtrace
 
         if action == "start":
-            return memtrace.start_trace()
+            return memtrace.start_trace(owner=self.id)
         if action == "stop":
-            return memtrace.stop_trace()
+            return memtrace.stop_trace(owner=self.id)
         return memtrace.worker_report(self, top_n=top_n)
 
     async def device_profile_handler(self, action: str = "stop",

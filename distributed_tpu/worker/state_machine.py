@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from distributed_tpu import config
+from distributed_tpu.diagnostics.census import build_worker_census
 from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.exceptions import InvalidTaskState, InvalidTransition
 from distributed_tpu.tracing import FlightRecorder
@@ -567,6 +568,22 @@ class WorkerState:
             ("rescheduled", "released"): self._transition_generic_released,
         }
 
+        # state census (diagnostics/census.py): typed inventory of every
+        # long-lived container above — the scheduler-side census's
+        # worker twin (docs/observability.md).  Built LAZILY on first
+        # access: a census is ~17 KiB of probe closures, and the
+        # simulator instantiates 10,000 of these machines whose
+        # censuses are only read at the quiesce gate (or under
+        # DTPU_CENSUS_CHECK).
+        self._census: Any = None
+
+    @property
+    def census(self) -> Any:
+        c = self._census
+        if c is None:
+            c = self._census = build_worker_census(self)
+        return c
+
     # ------------------------------------------------------------- stimulus
 
     def handle_stimulus(self, *events: StateMachineEvent) -> Instructions:
@@ -666,6 +683,11 @@ class WorkerState:
             if dts is None:
                 dts = self.tasks[dep_key] = WTaskState(dep_key)
                 dts.priority = ts.priority
+            # drop has_what rows for peers the fresh view no longer
+            # names (e.g. a dead worker): the replacement below would
+            # otherwise strand them forever (census-found)
+            for w in dts.who_has.difference(workers):
+                self._drop_has_what(w, dep_key)
             dts.who_has = set(workers)
             dts.nbytes = ev.nbytes.get(dep_key, dts.nbytes)
             ts.dependencies.add(dts)
@@ -699,6 +721,28 @@ class WorkerState:
                 # resolution as a flight dep whose gather fails.
                 ts.waiting_for_data.add(dts)
                 dts.waiters.add(ts)
+        # sever dependency edges from a previous incarnation that this
+        # compute-task no longer names: ``who_has`` carries EVERY
+        # current dependency (the target's own replicas included), so
+        # an edge absent from it is scheduler-authoritative stale —
+        # e.g. a pure-data input forgotten after its last replica
+        # vanished, whose recompute proceeds without it.  Left in
+        # place, waiting->ready demanded data that could never come
+        # (partition chaos + the census-era remove-replicas repair
+        # reproduced it deterministically).  Sorted: relation sets are
+        # hash-ordered here, and the forget recommendations must land
+        # in a process-independent order.
+        stale = sorted(
+            (d for d in ts.dependencies if d.key not in ev.who_has),
+            key=lambda d: d.key,
+        )
+        for dts in stale:
+            ts.dependencies.discard(dts)
+            dts.dependents.discard(ts)
+            ts.waiting_for_data.discard(dts)
+            dts.waiters.discard(ts)
+            if not dts.dependents and dts.state == "released":
+                recs[dts] = "forgotten"
         recs[ts] = "waiting"
         return recs, []
 
@@ -791,7 +835,7 @@ class WorkerState:
             self.in_flight_tasks.discard(ts)
             ts.coming_from = None
             ts.who_has.discard(ev.worker)
-            self.has_what[ev.worker].discard(key)
+            self._drop_has_what(ev.worker, key)
             instr.append(
                 MissingDataMsg(
                     stimulus_id=ev.stimulus_id, key=key, errant_worker=ev.worker
@@ -847,7 +891,7 @@ class WorkerState:
             self.in_flight_tasks.discard(ts)
             ts.coming_from = None
             ts.who_has.discard(ev.worker)
-            self.has_what[ev.worker].discard(key)
+            self._drop_has_what(ev.worker, key)
             instr.append(
                 MissingDataMsg(
                     stimulus_id=ev.stimulus_id, key=key, errant_worker=ev.worker
@@ -914,6 +958,8 @@ class WorkerState:
             if ts is None:
                 ts = self.tasks[key] = WTaskState(key)
                 ts.priority = (1_000_000,)  # replicas fetch at low priority
+            for w in ts.who_has.difference(workers):
+                self._drop_has_what(w, key)
             ts.who_has = set(workers)
             ts.nbytes = ev.nbytes.get(key, ts.nbytes)
             if ts.state in ("released", "missing") and key not in self.data:
@@ -985,6 +1031,11 @@ class WorkerState:
             ts = self.tasks.get(key)
             if ts is None:
                 continue
+            # drop rows for peers that no longer hold the key — a
+            # refresh that only ever added left one has_what row per
+            # departed replica behind (census-found)
+            for w in ts.who_has.difference(workers):
+                self._drop_has_what(w, key)
             ts.who_has = set(workers)
             for w in workers:
                 self.has_what[w].add(key)
@@ -1111,15 +1162,22 @@ class WorkerState:
     def _transition_released_forgotten(self, ts, *, stimulus_id):
         if ts.dependents:
             return {}, []
+        recs: Recs = {}
         for dts in ts.dependencies:
             dts.dependents.discard(ts)
             dts.waiters.discard(ts)
             if not dts.dependents and dts.state == "released":
-                pass  # will be forgotten by its own release path
+                # orphaned released dependency: no release path will
+                # ever run for it again, so forget it NOW (reference
+                # wsm.py does the same; the old no-op here retained
+                # ~14% of WTaskStates per chunk — found by the state
+                # census's quiesce gate, tests/test_census.py)
+                recs[dts] = "forgotten"
         ts.dependencies.clear()
+        self._purge_replicas(ts)
         self.tasks.pop(ts.key, None)
         ts.state = "forgotten"
-        return {}, []
+        return recs, []
 
     def _transition_redirected_waiting(self, ts, *, stimulus_id):
         """A data-target (fetch/missing) or failed task re-assigned as a
@@ -1407,10 +1465,33 @@ class WorkerState:
             dts.waiters.discard(ts)
             if not dts.waiters and not dts.dependents - {ts} and dts.state == "released":
                 recs[dts] = "forgotten"
+        self._purge_replicas(ts)
         ts.state = "released"
         if not ts.dependents:
             recs[ts] = "forgotten"
         return recs, []
+
+    def _drop_has_what(self, worker: str, key: Key) -> None:
+        """Remove one ``has_what`` row without the defaultdict creating
+        an empty per-peer shell for an unknown worker (and deleting the
+        shell when the last row goes — with peer churn the empty sets
+        themselves leak)."""
+        s = self.has_what.get(worker)
+        if s is not None:
+            s.discard(key)
+            if not s:
+                del self.has_what[worker]
+
+    def _purge_replicas(self, ts) -> None:
+        """Drop the task's peer-replica bookkeeping: ``who_has`` and the
+        per-peer ``has_what`` rows (empty rows deleted — with peer churn
+        the empty-set shells themselves are a leak).  Reference wsm.py
+        does this in ``_purge_state``; the census quiesce gate found
+        released tasks pinning both sides here."""
+        if ts.who_has:
+            for w in ts.who_has:
+                self._drop_has_what(w, ts.key)
+            ts.who_has.clear()
 
     # ---------------------------------------------------------- helper bits
 

@@ -157,6 +157,19 @@ def test_bench_smoke_runs_and_reports():
     # through the batched engine to the identical transition stream
     # (the bench half raises on any violation; these asserts pin the
     # contract in the gate's own output)
+    # state census + retention sentinel (diagnostics/census.py,
+    # docs/observability.md "State census & retention"): census-on
+    # engine floods stay under the 5% budget (min-per-pair-ratio),
+    # sentinel ticks are allocation-free, and a live run-then-quiesce
+    # LocalCluster ends census-clean on every role with all
+    # walk-vs-counter audits green (the bench half raises on any
+    # violation; these asserts pin the contract in the gate's output)
+    census = out["configs"]["census"]
+    assert census["overhead_pct"] < 5.0
+    assert census["alloc_delta_blocks"] < 50
+    assert census["live_clean"] is True
+    assert census["live_censuses"] == 3  # scheduler + 2 workers
+    assert census["live_families"] > 100
     sim = out["configs"]["sim"]
     assert sim["deterministic"] is True
     assert sim["virtual_makespan_s"] > 0

@@ -261,6 +261,11 @@ def test_check_mode_catches_injected_divergence(monkeypatch):
     monkeypatch.setenv("DTPU_NATIVE_CHECK", "1")
     _oracle, nat = _build_pair(seed=4, width=16, layers=2)
     ne = nat.native
+    # consume the dirty marks the ingest left behind (the unreachable-
+    # task cull dirties its dependency neighborhood) BEFORE corrupting:
+    # the next flood's resync would otherwise heal the injected
+    # divergence and the audit would rightly find nothing
+    ne.flush()
     ts = next(iter(nat.tasks.values()))
     ne.lib.eng_task_who_wants(ne.h, ts.nrow, 99)  # corrupt
     with pytest.raises(AssertionError, match="diverged"):

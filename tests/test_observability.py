@@ -517,9 +517,14 @@ async def test_memory_trace_roundtrip():
                 )
                 assert rep["data_store"]["keys"] >= 0
             stopped = await c.memory_trace_stop()
-            assert all(
-                r["tracing"] is False for r in stopped.values()
-            )
+            # stop is refcounted per server (diagnostics/memtrace.py):
+            # each response reports whether the process-global trace is
+            # STILL live — only the last owner's stop reads False, and
+            # after the broadcast nothing must be tracing
+            import tracemalloc
+
+            assert any(r["tracing"] is False for r in stopped.values())
+            assert not tracemalloc.is_tracing()
 
 
 @gen_test(timeout=120)
@@ -1126,6 +1131,15 @@ def test_metrics_names_unique_and_documented():
 
     _Sched.durability = DurabilityManager(_Sched.state, MemorySink())
     _Sched.durability.snapshot(full=True)
+    # seed the state census + retention sentinel on both roles so every
+    # dtpu_census_* family is exercised (diagnostics/census.py;
+    # docs/observability.md "State census & retention")
+    from distributed_tpu.diagnostics.census import RetentionSentinel
+
+    _Sched.state.census.sentinel = RetentionSentinel(
+        _Sched.state.census, trace=_Sched.state.trace
+    )
+    _Sched.state.census.sentinel.tick()
 
     class _SpillDict(dict):  # enables the spill metric lines
         spilled_count = 0
@@ -1142,6 +1156,10 @@ def test_metrics_names_unique_and_documented():
     _Worker.telemetry.record("tcp://pm:2", "tcp://pm:3", 1000, 0.001)
     with _Worker.state.wall.phase("wengine.stimulus", "pm-stim"):
         pass
+    _Worker.state.census.sentinel = RetentionSentinel(
+        _Worker.state.census, trace=_Worker.state.trace
+    )
+    _Worker.state.census.sentinel.tick()
 
     repo = Path(__file__).resolve().parent.parent
     docs = (repo / "docs/observability.md").read_text()
@@ -1233,7 +1251,15 @@ def test_metrics_names_unique_and_documented():
             "dtpu_loop_lag_seconds_sum",
             "dtpu_loop_lag_seconds_count",
             "dtpu_loop_ticks_total",
-            "dtpu_loop_stalls_total"} <= all_names
+            "dtpu_loop_stalls_total",
+            "dtpu_census_families",
+            "dtpu_census_quiesced",
+            "dtpu_census_count",
+            "dtpu_census_growth_per_s",
+            "dtpu_census_audits_total",
+            "dtpu_census_audit_failures_total",
+            "dtpu_census_findings_total",
+            "dtpu_census_leaks_flagged_total"} <= all_names
     if _Sched.state.native is not None:
         assert {"dtpu_engine_native_transitions_total",
                 "dtpu_engine_native_escapes_total",
