@@ -2522,10 +2522,15 @@ def _smoke_engine() -> dict:
       stories, per-destination message multisets),
     - absorb the four compiled arms natively (escape rate < 10% of
       transitions — the sim_10k trace measures ~0%),
-    - hold a same-session speedup >= 1.3x on the batch-plane flood,
+    - DEFER: a no-introspection flood hydrates zero tape rows inside
+      the stimulus call (the authoritative-SoA contract — python truth
+      materializes at the next read, outside the engine plane),
+    - hold a same-session speedup >= 10x on the engine plane (the
+      stimulus_tasks_finished_batch calls alone, batch building and
+      deferred hydration excluded) and >= 1.3x on the whole flood loop
+      including the python-side batch building + replay, both
       best-of-pairs (one-sided box-phase noise shrinks single pairs; a
-      real regression drops EVERY pair — measured pairs run 1.8-2.1x
-      on this box, PERF.md Round 11), and
+      real regression drops EVERY pair — PERF.md Round 12), and
     - allocate nothing per flood in the bridge's steady state (stale-
       completion floods: prep + native drain + tape apply with no state
       growth, the PR 6 getallocatedblocks pattern).
@@ -2593,8 +2598,18 @@ def _smoke_engine() -> dict:
         return state
 
     def flood(state, collect=False):
+        """Drive to quiescence.  Returns (wall_total, wall_engine,
+        hydrations_in_timer, rounds_out): wall_engine times ONLY the
+        stimulus_tasks_finished_batch calls — the batch-plane engine
+        wall the >=10x gate measures.  Batch building (list(ws.
+        processing), which hydrates the previous flood's deferred
+        segments) stays outside the engine timer, and the hydration
+        counter is sampled around each timed call so the gate can
+        assert the engine plane itself hydrates nothing."""
         rounds, out = 0, []
-        t0 = time.perf_counter()
+        eng, hyd = 0.0, 0
+        ne = getattr(state, "native", None)
+        t_all = time.perf_counter()
         with dtpu_config.set(OVR):
             while True:
                 batch = [
@@ -2610,12 +2625,17 @@ def _smoke_engine() -> dict:
                 ]
                 if not batch:
                     break
+                h0 = ne.hydrations if ne is not None else 0
+                t0 = time.perf_counter()
                 r = state.stimulus_tasks_finished_batch(batch)
+                eng += time.perf_counter() - t0
+                if ne is not None:
+                    hyd += ne.hydrations - h0
                 if collect:
                     out.append(r)
                 rounds += 1
                 assert rounds < 5000
-        return time.perf_counter() - t0, out
+        return time.perf_counter() - t_all, eng, hyd, out
 
     def freeze(obj):
         if isinstance(obj, dict):
@@ -2651,8 +2671,8 @@ def _smoke_engine() -> dict:
 
     # --- bit-parity on a randomized flood ----------------------------
     a, b = build(False, seed=3), build(True, seed=3)
-    _, ra = flood(a, collect=True)
-    _, rb = flood(b, collect=True)
+    _, _, _, ra = flood(a, collect=True)
+    _, _, _, rb = flood(b, collect=True)
     assert snap(a) == snap(b), "native/oracle state mismatch"
     assert [r[:5] for r in a.transition_log] ==         [r[:5] for r in b.transition_log], "story mismatch"
     assert canon(ra) == canon(rb), "message mismatch"
@@ -2665,15 +2685,33 @@ def _smoke_engine() -> dict:
         f"absorbing their share ({counters})"
     )
 
-    # --- same-session speedup (min-of-pairs, drift-robust) -----------
+    # --- same-session speedup (best-of-pairs, drift-robust) ----------
+    # Two planes per pair: the ENGINE plane (stimulus calls only — the
+    # deferred-materialization contract keeps python bookkeeping out of
+    # it, gate >= 10x) and the whole flood loop including the python
+    # batch builds that hydrate the previous round (legacy gate 1.3x).
     flood(build(False))
     flood(build(True))
-    ratios = []
+    ratios, eng_ratios, hyd_in_timer = [], [], 0
     for _ in range(REPS):
-        wo, _ = flood(build(False))
-        wn, _ = flood(build(True))
+        wo, eo, _, _ = flood(build(False))
+        wn, en, h, _ = flood(build(True))
         ratios.append(wo / wn)
+        eng_ratios.append(eo / en)
+        hyd_in_timer += h
     speedup = max(ratios)
+    speedup_engine = max(eng_ratios)
+    assert hyd_in_timer == 0, (
+        f"{hyd_in_timer} rows hydrated INSIDE the engine timer — a "
+        "no-introspection flood must defer every segment (escape or "
+        "stray read on the stimulus path is dragging replay back into "
+        "the engine plane)"
+    )
+    assert speedup_engine >= 10.0, (
+        f"engine-plane speedup {speedup_engine:.2f}x under the 10x "
+        f"floor (pairs {[round(r, 1) for r in eng_ratios]}; PERF.md "
+        f"Round 12)"
+    )
     assert speedup >= 1.3, (
         f"native flood speedup {speedup:.2f}x under the 1.3x floor "
         f"(pairs {[round(r, 2) for r in ratios]})"
@@ -2683,12 +2721,18 @@ def _smoke_engine() -> dict:
     st = build(True, seed=4)
     stale = [(f"ghost-{i}", "sim://w0", f"g{i}", {"nbytes": 8})
              for i in range(64)]
+    def drain(r):
+        # consume the lazy flood messages: the read barrier replays the
+        # deferred segment and returns its tape to the pool, so the
+        # steady state the block budget measures includes recycling
+        return sum(len(v) for v in r[1].values())
+
     with dtpu_config.set(OVR):
         for _ in range(4):
-            st.stimulus_tasks_finished_batch(list(stale))
+            drain(st.stimulus_tasks_finished_batch(list(stale)))
         b0 = _sys.getallocatedblocks()
         for _ in range(32):
-            st.stimulus_tasks_finished_batch(list(stale))
+            drain(st.stimulus_tasks_finished_batch(list(stale)))
         alloc_delta = _sys.getallocatedblocks() - b0
     assert alloc_delta < 300, (
         f"native flood path leaked {alloc_delta} blocks over 32 "
@@ -2704,6 +2748,9 @@ def _smoke_engine() -> dict:
         "parity": True,
         "speedup_best": round(speedup, 2),
         "speedup_pairs": [round(r, 2) for r in ratios],
+        "speedup_engine_best": round(speedup_engine, 2),
+        "speedup_engine_pairs": [round(r, 1) for r in eng_ratios],
+        "hydrations_in_timer": hyd_in_timer,
         "alloc_delta_blocks": alloc_delta,
         "host_canary_ms": _host_canary_ms(),
     }

@@ -165,3 +165,144 @@ class MirrorParityRule(Rule):
                 if f is not None:
                     return f, f"in-place `{func.attr}`"
         return None
+
+
+# ------------------------------------------------------------ soa-hydration
+
+#: SoA-backed underscore slots (scheduler/state.py property pairs): the
+#: public name drains deferred native segments before every read/write;
+#: the underscore slot is the raw storage the drain-first contract
+#: protects.  A stray write to the slot bypasses the materialization
+#: barrier and silently diverges python truth from the authoritative
+#: C++ rows (docs/native_engine.md).
+_SOA_TASK_FIELDS = frozenset({
+    "_state", "_waiting_on", "_waiters", "_who_has", "_processing_on",
+    "_nbytes", "_type", "_metadata", "_homed", "_ledger_row",
+})
+_SOA_WORKER_FIELDS = frozenset({
+    "_nbytes", "_has_what", "_processing", "_long_running", "_occupancy",
+})
+_SOA_SCHED_FIELDS = frozenset({"_transition_log"})
+_SOA_FIELDS = _SOA_TASK_FIELDS | _SOA_WORKER_FIELDS | _SOA_SCHED_FIELDS
+
+#: names TaskState / SchedulerState bindings go by in scheduler code
+#: (WorkerState names are shared with the mirror rule above)
+_TS_NAMES = frozenset({"ts", "dts", "ts0", "ts1", "ts2", "tts",
+                       "task_state"})
+_SS_NAMES = frozenset({"s", "state", "sched_state"})
+
+#: the write-back registry: enclosing functions allowed to touch the
+#: raw slots.  Construction and the property accessors themselves
+#: (named after the field, sans underscore), plus the deferred-replay
+#: appliers — the ONLY code that materializes native truth into the
+#: slots (NativeEngine.sync / _apply_tape_inner).
+_SOA_ALLOWED_FUNCS = frozenset(
+    {"__init__", "clean", "sync", "_apply_tape_inner"}
+    | {f.lstrip("_") for f in _SOA_FIELDS}
+)
+
+#: classes whose ``self`` carries SoA-backed slots
+_SOA_CLASSES = ("TaskState", "WorkerState", "SchedulerState")
+
+
+@register
+class SoaHydrationRule(Rule):
+    name = "soa-hydration"
+    description = (
+        "SoA-backed underscore slots (_state/_waiting_on/…/_occupancy/"
+        "_transition_log) mutate only inside registered hydration/"
+        "write-back helpers — stray writes bypass the deferred-"
+        "materialization barrier"
+    )
+    scope = ("distributed_tpu/scheduler/**",)
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        for mod in ctx.modules(self):
+            astutils.add_parents(mod.tree)
+            # method names of the slot-carrying classes in this module,
+            # so ``self._field`` inside them is recognized
+            soa_methods: set[str] = set()
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and node.name in _SOA_CLASSES
+                ):
+                    for item in node.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            soa_methods.add(item.name)
+            for node in ast.walk(mod.tree):
+                hit = self._mutation(node, soa_methods)
+                if hit is None:
+                    continue
+                field, kind = hit
+                fn = astutils.enclosing_function_name(node)
+                if fn.rsplit(".", 1)[-1] in _SOA_ALLOWED_FUNCS:
+                    continue
+                yield Finding(
+                    rule=self.name, path=mod.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{kind} of SoA-backed slot `{field}` outside "
+                        f"the registered hydration/write-back helpers — "
+                        f"use the public property (it drains deferred "
+                        f"native segments first) or register the helper "
+                        f"in analysis/rules/mirror_parity.py "
+                        f"(_SOA_ALLOWED_FUNCS)"
+                    ),
+                    symbol=fn,
+                )
+
+    @staticmethod
+    def _mutation(node: ast.AST, soa_methods: set[str]) -> tuple[str, str] | None:
+        """(field, kind) when ``node`` writes a SoA-backed slot."""
+
+        def soa_attr(expr: ast.expr) -> str | None:
+            if not (
+                isinstance(expr, ast.Attribute) and expr.attr in _SOA_FIELDS
+            ):
+                return None
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id in _TS_NAMES | _WS_NAMES | _SS_NAMES:
+                    return expr.attr
+                if base.id == "self":
+                    fn = astutils.enclosing_function_name(expr)
+                    if fn.rsplit(".", 1)[-1] in soa_methods:
+                        return expr.attr
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                f = soa_attr(tgt)
+                if f is not None:
+                    return f, "assignment"
+                if isinstance(tgt, ast.Subscript):
+                    f = soa_attr(tgt.value)
+                    if f is not None:
+                        return f, "item assignment"
+            # x = ts._waiting_on.add — a bound-mutator alias escapes
+            # the write barrier just like a direct call
+            if isinstance(node, ast.Assign):
+                v = node.value
+                if isinstance(v, ast.Attribute) and v.attr in _MUTATORS:
+                    f = soa_attr(v.value)
+                    if f is not None:
+                        return f, f"bound-mutator alias `{v.attr}`"
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    f = soa_attr(tgt.value)
+                    if f is not None:
+                        return f, "item deletion"
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                f = soa_attr(func.value)
+                if f is not None:
+                    return f, f"in-place `{func.attr}`"
+        return None

@@ -753,7 +753,7 @@ def build_scheduler_census(state: Any) -> StateCensus:
         "transition-log", lambda: len(state.transition_log), kind="ring",
         allow=True, reason="bounded deque "
         "(scheduler.transition-log-length)",
-        attrs=("transition_log",),
+        attrs=("_transition_log",),
     )
     c.register(
         "events",
@@ -984,6 +984,57 @@ def build_scheduler_census(state: Any) -> StateCensus:
         allow=True, reason="interned prefix/group id maps (bounded by "
         "the key vocabulary)",
     )
+    # authoritative-SoA families (deferred materialization): parked
+    # segments must drain to zero at quiesce (every release goes
+    # through a sync-first mutation hook), and the hydrated python
+    # rows — the "hydration cache" — must empty with the tasks
+    c.register(
+        "native.pending-segments",
+        lambda: len(_native("_pending", ())), kind="scratch",
+    )
+    c.register(
+        "native.tape-pool", lambda: len(_native("_tape_pool", ())),
+        kind="pool",
+        allow=True, reason="recycled tape buffers (bounded: one per "
+        "concurrently-deferred segment, reused across floods)",
+    )
+
+    def _eng_counts(i: int) -> int:
+        # live-row counts read from the C++ side: the authoritative
+        # store's own accounting, audited against a python-mirror walk
+        n = state.native
+        if n is None or n.h is None:
+            return 0
+        import ctypes as _ct
+        out = (_ct.c_int64 * 6)()
+        n.lib.eng_counts(n.h, out)
+        return int(out[i])
+
+    c.register(
+        "native.soa-rows", lambda: _eng_counts(0), kind="state",
+        cost="walk",
+        # rows allocated but never yet flushed (_fresh) are python-only:
+        # subtract them so the walk matches the C++ live count exactly
+        walk=lambda: sum(1 for ts in _native("_rows") if ts is not None)
+        - len(_native("_fresh", ())),
+        sample=lambda: (ts for ts in _native("_rows") if ts is not None),
+    )
+    c.register(
+        "native.soa-workers", lambda: _eng_counts(2), kind="state",
+        cost="walk",
+        walk=lambda: sum(1 for ws in _native("_wslots") if ws is not None),
+        allow=True, reason="one live SoA slot per registered worker "
+        "(drains on worker close, not task release)",
+    )
+    c.register(
+        "native.hydration-cache",
+        lambda: (
+            max(0, sum(1 for ts in _native("_rows") if ts is not None)
+                - sum(p[1] for p in _native("_pending", ())))
+        ),
+        kind="state", cost="walk",
+        sample=lambda: (ts for ts in _native("_rows") if ts is not None),
+    )
 
     # ---- durability (attached by the server / sim when enabled)
     def _durability(attr: str) -> int:
@@ -1025,6 +1076,7 @@ def build_scheduler_census(state: Any) -> StateCensus:
     # the quiesce residue scan, which probes every family
     c.motion = (
         "tasks", "queue.queued", "queue.unrunnable", "steal.in-flight",
+        "native.pending-segments",
     )
     return c
 

@@ -917,6 +917,39 @@ def scheduler_metrics(scheduler: Any) -> bytes:
             prom_line("dtpu_engine_native_oracle_transitions_total",
                       c["oracle_transitions"])
         )
+        # deferred materialization (authoritative SoA): how much python
+        # truth deferred replay has had to build, and how often a read
+        # barrier found everything already hydrated.  A hydration count
+        # tracking the transition count means something reads python
+        # objects every flood — the lazy contract is not paying off.
+        lines.append(
+            "# HELP dtpu_engine_hydrations_total Tape rows replayed "
+            "into python objects by deferred materialization"
+        )
+        lines.append("# TYPE dtpu_engine_hydrations_total counter")
+        lines.append(
+            prom_line("dtpu_engine_hydrations_total", c["hydrations"])
+        )
+        lines.append(
+            "# HELP dtpu_engine_hydration_cache_hits_total Sync-barrier "
+            "probes that found no deferred segments pending"
+        )
+        lines.append(
+            "# TYPE dtpu_engine_hydration_cache_hits_total counter"
+        )
+        lines.append(
+            prom_line("dtpu_engine_hydration_cache_hits_total",
+                      c["hydration_cache_hits"])
+        )
+        lines.append(
+            "# HELP dtpu_engine_hydration_cache_rows Live task rows "
+            "whose python mirror is fully materialized (hydrated)"
+        )
+        lines.append("# TYPE dtpu_engine_hydration_cache_rows gauge")
+        lines.append(
+            prom_line("dtpu_engine_hydration_cache_rows",
+                      c["hydration_cache_rows"])
+        )
     # batched-engine + egress-coalescer histograms (tracing.Histogram,
     # observed in scheduler/state.py and Scheduler.stream_payload_flush)
     for name, hist, help_ in (

@@ -224,6 +224,12 @@ class DecisionLedger:
         # digest only means something under a deterministic clock.
         self.digest_enabled = False
         self._h = hashlib.blake2b(digest_size=16)
+        # deferred-materialization barrier (docs/native_engine.md
+        # "authoritative SoA"): when a native engine attaches it points
+        # this at its sync(), and every READ method below calls it first
+        # so deferred file/join rows fold into the ring and digest
+        # before the read observes them.  None = no native engine.
+        self.barrier: Any = None
 
 
     # ------------------------------------------------------------- filing
@@ -238,7 +244,8 @@ class DecisionLedger:
              pred_measured: float = 0.0, used_measured: bool = False,
              dep_bytes: int = 0, n_deps: int = 0,
              duration_pred: float = 0.0, src: str = "",
-             plan_stim: str = "", supersede: int = -1) -> int:
+             plan_stim: str = "", supersede: int = -1,
+             now: float | None = None) -> int:
         """File one task-cost decision row (placement/plan/steal kinds)
         and return its handle (park it on the task; join with
         :meth:`join_row`).
@@ -246,6 +253,10 @@ class DecisionLedger:
         ``supersede``: the task's previously-open row handle, finalized
         as ``superseded`` — its prediction was replaced before reality
         could test it.  Returns -1 when disabled.
+
+        ``now``: decision stamp override.  The native engine's deferred
+        replay passes the flood-hoisted clock so ``t_decision`` — which
+        the digest folds verbatim — matches what the eager path stamped.
         """
         if not self.enabled:
             return -1
@@ -266,8 +277,8 @@ class DecisionLedger:
         # fields are undefined by contract (consumers key on `outcome`)
         ring[off:off + _OUTCOME + 1] = (
             i, kind, key, prefix, worker, src, stim, plan_stim,
-            self.clock(), pred_constant, pred_measured,
-            1 if used_measured else 0, dep_bytes, n_deps,
+            self.clock() if now is None else now, pred_constant,
+            pred_measured, 1 if used_measured else 0, dep_bytes, n_deps,
             duration_pred, 0.0, "",
         )
         self._i = i + 1
@@ -490,6 +501,9 @@ class DecisionLedger:
         """Read-time Histogram views over the flat per-kind stats (the
         /metrics exposition's shape; built per call, never mutated on
         the hot path)."""
+        b = self.barrier
+        if b is not None:
+            b()
         out: dict[tuple[str, str], Histogram] = {}
         for kind, st in self._kind_stats.items():
             hc = Histogram(REGRET_BUCKETS)
@@ -507,6 +521,9 @@ class DecisionLedger:
     @property
     def kind_agg(self) -> dict[str, list]:
         """``kind -> [n, sum_c, sum_m, abs_c, abs_m]`` view."""
+        b = self.barrier
+        if b is not None:
+            b()
         return {k: st[:5] for k, st in self._kind_stats.items()}
 
     # ----------------------------------------------------------- lifecycle
@@ -518,6 +535,9 @@ class DecisionLedger:
         decisions must never linger awaiting a join that cannot come.
         One bounded ring scan per removal (removals are rare; the hot
         path carries no per-worker index)."""
+        b = self.barrier
+        if b is not None:
+            b()
         if not self.open_rows:
             return 0
         ring = self._ring
@@ -536,6 +556,9 @@ class DecisionLedger:
     def resolve_all(self, outcome: str = "released",
                     now: float | None = None) -> int:
         """Finalize every open row (scheduler restart / state clear)."""
+        b = self.barrier
+        if b is not None:
+            b()
         if not self.open_rows:
             return 0
         ring = self._ring
@@ -550,12 +573,18 @@ class DecisionLedger:
     @property
     def filed_total(self) -> int:
         """Rows ever filed (every file advances the ring head)."""
+        b = self.barrier
+        if b is not None:
+            b()
         return self._i
 
     @property
     def open_rows(self) -> int:
         """Decisions still awaiting their outcome — derived: filed
         minus every finalized row."""
+        b = self.barrier
+        if b is not None:
+            b()
         return (
             self._i - self._memory_joins - self.unjoined_total
             - sum(self._outcomes.values())
@@ -563,12 +592,18 @@ class DecisionLedger:
 
     @property
     def superseded_total(self) -> int:
+        b = self.barrier
+        if b is not None:
+            b()
         return self._outcomes.get("superseded", 0)
 
     @property
     def joined_total(self) -> int:
         """Rows joined to a realized outcome — derived: every filed row
         is exactly one of open / unjoined / superseded / joined."""
+        b = self.barrier
+        if b is not None:
+            b()
         return (
             self.filed_total - self.open_rows
             - self.unjoined_total - self.superseded_total
@@ -576,6 +611,9 @@ class DecisionLedger:
 
     @property
     def outcomes(self) -> dict[str, int]:
+        b = self.barrier
+        if b is not None:
+            b()
         out = dict(self._outcomes)
         if self._memory_joins:
             out["memory"] = self._memory_joins
@@ -584,11 +622,17 @@ class DecisionLedger:
     # ------------------------------------------------------------ reading
 
     def __len__(self) -> int:
+        b = self.barrier
+        if b is not None:
+            b()
         return min(self._i, self._mask + 1)
 
     def tail(self, n: int | None = None) -> list[dict]:
         """Newest ``n`` (default all resident) rows as dicts, oldest
         first — the /ledger wire format and the dump/analyzer input."""
+        b = self.barrier
+        if b is not None:
+            b()
         total = self._i
         count = min(total, self._mask + 1)
         if n is not None:
@@ -608,6 +652,9 @@ class DecisionLedger:
         signed / mean abs, both models), the whole-ledger aggregate-
         regret comparison (the ROADMAP item 1 calibration artifact),
         and bounded per-prefix / per-link aggregates."""
+        b = self.barrier
+        if b is not None:
+            b()
         kinds = {}
         tot_n = 0
         tot_abs_c = tot_abs_m = tot_sum_c = tot_sum_m = 0.0
@@ -671,6 +718,9 @@ class DecisionLedger:
     def snapshot(self, n: int | None = None) -> list[dict]:
         """The /ledger JSONL payload: one summary record followed by the
         resident row tail."""
+        b = self.barrier
+        if b is not None:
+            b()
         head = self.summary()
         head["type"] = "ledger-summary"
         return [head, *self.tail(n)]
@@ -679,6 +729,9 @@ class DecisionLedger:
         """Hex digest over every row finalized so far — same seed, same
         workload, same overrides => bit-identical (the sim determinism
         contract extended to decisions-vs-outcomes)."""
+        b = self.barrier
+        if b is not None:
+            b()
         return self._h.hexdigest()
 
     def __repr__(self) -> str:

@@ -260,6 +260,8 @@ def load() -> ctypes.CDLL | None:
         lib.eng_escapes.argtypes = [_vp]
         lib.eng_escape_count.restype = ctypes.c_int64
         lib.eng_escape_count.argtypes = [_vp, ctypes.c_int32]
+        lib.eng_counts.restype = None
+        lib.eng_counts.argtypes = [_vp, _i64p]
         lib.eng_replica_add.argtypes = [_vp, ctypes.c_int32, ctypes.c_int32]
         lib.eng_replica_remove.argtypes = [
             _vp, ctypes.c_int32, ctypes.c_int32

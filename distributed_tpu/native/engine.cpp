@@ -868,6 +868,23 @@ int64_t eng_escape_count(void *h, int32_t why) {
     return (why >= 0 && why < 16) ? e.why_counts[why] : 0;
 }
 
+// Live-row counts for the census walk-vs-counter audit on the
+// authoritative SoA families: [0] live task rows, [1] task row
+// capacity, [2] live worker slots, [3] worker slot capacity,
+// [4] prefix rows, [5] group rows.
+void eng_counts(void *h, int64_t *out) {
+    Engine &e = *(Engine *)h;
+    int64_t lt = 0, lw = 0;
+    for (const Task &t : e.tasks) lt += t.live;
+    for (const Worker &w : e.workers) lw += w.live;
+    out[0] = lt;
+    out[1] = (int64_t)e.tasks.size();
+    out[2] = lw;
+    out[3] = (int64_t)e.workers.size();
+    out[4] = (int64_t)e.prefixes.size();
+    out[5] = (int64_t)e.groups.size();
+}
+
 // Incremental deltas for the frequent between-flood mutations (the
 // add-keys/AMM replica traffic and nbytes/who_wants updates): one call
 // instead of a full dirty-row resync.  Harmless on rows that are also

@@ -230,6 +230,13 @@ class DurabilityTracker:
         self.removed_workers[ws.address] = None
 
     def drain(self) -> tuple[list[str], list[str], list[str], list[str]]:
+        # deferred native segments carry their own dirty marks (the
+        # replay appliers call mark_transition/mark_replica): a delta
+        # snapshot taken after a purely-native flood must force replay
+        # first or it would capture an empty dirty set
+        ne = getattr(self.state, "native", None)
+        if ne is not None and ne._pending:
+            ne.sync()
         out = (
             list(self.dirty_tasks), list(self.removed_tasks),
             list(self.dirty_workers), list(self.removed_workers),

@@ -14,6 +14,20 @@ and the C++ vectors mirror it exactly.  Messages, story rows, journal
 records, ledger rows and plugin calls are all built from python truth,
 which is what makes the output bit-identical to the oracle.
 
+Replay is DEFERRED (the "authoritative SoA" contract,
+docs/native_engine.md): a completed native segment stashes its tape
+plus context on ``self._pending`` instead of replaying immediately, so
+the flood's timed path is just prep + flush + the C++ call.  The SoA is
+the source of truth until ``sync()`` replays every pending segment in
+original order — triggered by the SoA-backed TaskState/WorkerState
+property accessors (``state._NATIVE_PENDING``), the ledger/telemetry
+read barriers, the lazy message dicts the drives return, and every
+python-side mutation hook below.  Deferral changes WHEN the python
+objects materialize, never what they materialize to: replay runs the
+same appliers against unchanged starting state, with the flood's
+hoisted clock stamp threaded through so ledger digests stay
+bit-identical.
+
 Anything an arm needs that the core does not model ESCAPES to the
 python oracle per key: the drain stops at a transition boundary, the
 tape so far is applied, and the popped transition plus the pending
@@ -38,6 +52,7 @@ from typing import TYPE_CHECKING, Any
 from distributed_tpu import native
 from distributed_tpu.protocol.serialize import wrap_opaque
 from distributed_tpu.scheduler.state import (
+    _NATIVE_PENDING,
     _merge_msgs_inplace as _merge,
 )
 
@@ -132,6 +147,88 @@ class _Buf:
         return self.arr
 
 
+class _LazyMsgs(dict):
+    """Per-destination message dict returned by the deferred drives.
+
+    Deferred native segments hold a reference to this dict and append
+    their message rows only at ``sync()`` — so every READ materializes
+    pending segments first, keeping per-destination message order
+    identical to the oracle's.  The writer path (``setdefault``) stays
+    non-syncing on purpose: the appliers and the post-sync oracle
+    escape paths write through it, and a sync from inside the applier
+    would recurse.
+    """
+
+    __slots__ = ("_eng",)
+
+    def __init__(self, eng):
+        super().__init__()
+        self._eng = eng
+
+    def _sync(self):
+        eng = self._eng
+        if eng._pending:
+            eng.sync()
+
+    def __iter__(self):
+        self._sync()
+        return dict.__iter__(self)
+
+    def __len__(self):
+        self._sync()
+        return dict.__len__(self)
+
+    def __contains__(self, k):
+        self._sync()
+        return dict.__contains__(self, k)
+
+    def __getitem__(self, k):
+        self._sync()
+        return dict.__getitem__(self, k)
+
+    def __eq__(self, other):
+        self._sync()
+        return dict.__eq__(self, other)
+
+    def __ne__(self, other):
+        self._sync()
+        return dict.__ne__(self, other)
+
+    __hash__ = None
+
+    def __repr__(self):
+        self._sync()
+        return dict.__repr__(self)
+
+    def get(self, k, default=None):
+        self._sync()
+        return dict.get(self, k, default)
+
+    def keys(self):
+        self._sync()
+        return dict.keys(self)
+
+    def values(self):
+        self._sync()
+        return dict.values(self)
+
+    def items(self):
+        self._sync()
+        return dict.items(self)
+
+    def copy(self):
+        self._sync()
+        return dict(self)
+
+    def pop(self, *a):
+        self._sync()
+        return dict.pop(self, *a)
+
+    def popitem(self):
+        self._sync()
+        return dict.popitem(self)
+
+
 class NativeEngine:
     """Per-SchedulerState bridge to one C++ engine instance."""
 
@@ -154,6 +251,10 @@ class NativeEngine:
         # dirty sets (python-side mutations pending resync)
         self._dirty: set = set()
         self._dirty_workers: set = set()
+        # row indices allocated but never yet flushed into the SoA:
+        # lets the census walk compare python rows against the C++
+        # live count without forcing a flush (fresh ⊆ dirty always)
+        self._fresh: set = set()
         # the applier replays native mutations through the real helpers
         # (add_replica & co) for their mirror marks — the native dirty
         # hooks must NOT re-dirty rows the engine itself just wrote
@@ -169,9 +270,21 @@ class NativeEngine:
         self.min_flood = int(
             _config.get("scheduler.native-engine.min-flood")
         )
-        # tape buffers
-        self._tape_cap = 0
-        self._grow_tape(1 << 14)
+        # deferred materialization: completed native segments stash
+        # (tape, n, events, round_stim, stim, now, cmsgs, wmsgs) here
+        # instead of replaying immediately; sync() replays in order.
+        # Invariant: self is in state._NATIVE_PENDING iff _pending is
+        # non-empty (outside an in-flight sync).
+        self._pending: list = []
+        self._syncing = False
+        # tape buffers come from a free-list pool so a deferred tape is
+        # never overwritten by the next segment's native call
+        self._tape_pool: list = [self._alloc_tape(1 << 14)]
+        # hydration counters (dtpu_engine_hydration* metric families):
+        # tape rows materialized by deferred replay, and sync() probes
+        # that found everything already materialized
+        self.hydrations = 0
+        self.hyd_cache_hits = 0
         # persistent flush/prep buffers (ctypes array CONSTRUCTION is
         # ~2us each; 19 fresh arrays per flood was the dominant fixed
         # cost — slice-assignment into persistent buffers is a C loop).
@@ -210,19 +323,47 @@ class NativeEngine:
             ne.on_add_worker(ws)
         for ts in state.tasks.values():
             ne.on_new_task(ts)
+        # deferred-materialization read barriers: ledger and telemetry
+        # reads must fold pending native file/join rows first
+        state.ledger.barrier = ne.sync
+        if getattr(state.telemetry, "barrier", None) is None:
+            state.telemetry.barrier = ne.sync
         return ne
 
     def close(self) -> None:
+        self._drop_pending()
+        s = self.state
+        if s.ledger.barrier == self.sync:
+            s.ledger.barrier = None
+        if getattr(s.telemetry, "barrier", None) == self.sync:
+            s.telemetry.barrier = None
         if self.h:
             self.lib.eng_free(self.h)
             self.h = ctypes.c_void_p()
         self.ok = False
+
+    def _drop_pending(self) -> None:
+        """Forget deferred segments WITHOUT replaying (teardown/degrade
+        paths only — the normal path is sync())."""
+        self._pending.clear()
+        try:
+            _NATIVE_PENDING.remove(self)
+        except ValueError:
+            pass
 
     def detach(self) -> None:
         """Tear down fully: free the C++ engine AND clear the row/slot
         markers parked on the python objects, so a later attach_native
         starts from a clean world instead of adopting stale nrow/nidx
         ids into a fresh engine (reviewer-found)."""
+        if self._pending and not self._syncing:
+            try:
+                self.sync()
+            except Exception:
+                logger.exception(
+                    "deferred native segments lost at detach"
+                )
+                self._drop_pending()
         for ts in self._rows:
             if ts is not None:
                 ts.nrow = -1
@@ -234,6 +375,7 @@ class NativeEngine:
         self._wslots = []
         self._dirty.clear()
         self._dirty_workers.clear()
+        self._fresh.clear()
         self.close()
 
     # ----------------------------------------------------------- gating
@@ -257,8 +399,18 @@ class NativeEngine:
     #
     # Called from SchedulerState's mutation helpers (the delta-
     # consistency seam, same discipline as scheduler/mirror.py).
+    #
+    # Every hook is SYNC-FIRST under deferral (_materialize): a python
+    # mutation is about to land, so pending native segments must replay
+    # before it — which gives flush() its invariant that anything in
+    # the dirty sets was marked while python truth was current.
+
+    def _materialize(self) -> None:
+        if self._pending and not self._syncing:
+            self.sync()
 
     def on_new_task(self, ts: "TaskState") -> None:
+        self._materialize()
         if ts.nrow < 0:
             if self._row_free:
                 row = self._row_free.pop()
@@ -267,9 +419,11 @@ class NativeEngine:
                 row = len(self._rows)
                 self._rows.append(ts)
             ts.nrow = row
+            self._fresh.add(row)
         self._dirty.add(ts)
 
     def on_forget_task(self, ts: "TaskState") -> None:
+        self._materialize()  # pending tapes reference rows by index
         row = ts.nrow
         if row < 0:
             return
@@ -278,9 +432,13 @@ class NativeEngine:
         self._row_free.append(row)
         ts.nrow = -1
         self._dirty.discard(ts)
+        self._fresh.discard(row)
 
     def mark_task(self, ts: "TaskState") -> None:
-        if ts.nrow >= 0 and not self._applying:
+        if self._applying:
+            return
+        self._materialize()
+        if ts.nrow >= 0:
             self._dirty.add(ts)
 
     def mark_transition(self, ts: "TaskState") -> None:
@@ -288,6 +446,7 @@ class NativeEngine:
         relation neighborhoods may have changed."""
         if self._applying:  # pragma: no cover - applier never transitions
             return
+        self._materialize()
         d = self._dirty
         if ts.nrow >= 0:
             d.add(ts)
@@ -306,6 +465,7 @@ class NativeEngine:
                    add: bool) -> None:
         if self._applying:
             return
+        self._materialize()
         if ts.nrow < 0 or ws.nidx < 0:
             return
         if add:
@@ -314,25 +474,40 @@ class NativeEngine:
             self.lib.eng_replica_remove(self.h, ts.nrow, ws.nidx)
 
     def on_nbytes(self, ts: "TaskState", nbytes: int) -> None:
-        if not self._applying and ts.nrow >= 0:
+        if self._applying:
+            return
+        self._materialize()
+        if ts.nrow >= 0:
             self.lib.eng_task_nbytes(self.h, ts.nrow, nbytes)
 
     def on_who_wants(self, ts: "TaskState") -> None:
-        if not self._applying and ts.nrow >= 0:
+        if self._applying:
+            return
+        self._materialize()
+        if ts.nrow >= 0:
             self.lib.eng_task_who_wants(self.h, ts.nrow,
                                         len(ts.who_wants))
 
     def mark_worker(self, ws: "WorkerState") -> None:
-        if ws.nidx >= 0 and not self._applying:
+        if self._applying:
+            return
+        self._materialize()
+        if ws.nidx >= 0:
             self._dirty_workers.add(ws)
 
     def on_add_worker(self, ws: "WorkerState") -> None:
+        self._materialize()
         if ws.nidx < 0:
             ws.nidx = len(self._wslots)
             self._wslots.append(ws)
+            # eager upsert: every python slot has a live SoA twin from
+            # registration on (the census walk-vs-counter audit on
+            # native.soa-workers relies on this; adds are rare)
+            self._upsert_worker(ws)
         self._dirty_workers.add(ws)
 
     def on_remove_worker(self, ws: "WorkerState") -> None:
+        self._materialize()  # pending tapes reference wslots by index
         # slots are never reused (removals are rare; a rejoining
         # address gets a fresh WorkerState and a fresh slot)
         if ws.nidx >= 0:
@@ -354,7 +529,9 @@ class NativeEngine:
 
     def reset(self) -> None:
         """_clear_task_state: drop every task row (workers survive)."""
+        self._materialize()
         self._dirty.clear()
+        self._fresh.clear()
         for row, ts in enumerate(self._rows):
             if ts is not None:
                 self.lib.eng_task_forget(self.h, row)
@@ -405,6 +582,7 @@ class NativeEngine:
             return
         tasks = [ts for ts in self._dirty if ts.nrow >= 0]
         self._dirty.clear()
+        self._fresh.clear()
         if not tasks:
             return
         prefixes: set = set()
@@ -501,6 +679,11 @@ class NativeEngine:
 
     def _params(self) -> None:
         s = self.state
+        if self._pending:
+            # the python-side incremental total is stale while segments
+            # are deferred (its write-back runs at replay): the SoA
+            # total is authoritative, read it back before pushing
+            s._total_occupancy = self.lib.eng_total_occupancy(self.h)
         self.lib.eng_params(
             self.h, _f64(s.bandwidth), _f64(s.transfer_latency),
             _f64(s.UNKNOWN_TASK_DURATION), _f64(s.WORKER_SATURATION),
@@ -509,25 +692,89 @@ class NativeEngine:
             1 if s.placement is not None else 0,
         )
 
-    def _grow_tape(self, cap: int) -> None:
-        if cap <= self._tape_cap:
-            return
-        self._tape_cap = cap
-        self._t_op = (_i32 * cap)()
-        self._t_a = (_i32 * cap)()
-        self._t_b = (_i32 * cap)()
-        self._t_c = (_i32 * cap)()
-        self._t_f1 = (_f64 * cap)()
-        self._t_f2 = (_f64 * cap)()
+    # tape pool: a tape set is (cap, op, a, b, c, f1, f2).  Deferred
+    # segments own their tape until sync() returns it to the pool, so
+    # the next segment's native call can never overwrite pending rows.
 
-    def _set_tape(self, n_events: int) -> None:
+    @staticmethod
+    def _alloc_tape(cap: int):
+        return (cap, (_i32 * cap)(), (_i32 * cap)(), (_i32 * cap)(),
+                (_i32 * cap)(), (_f64 * cap)(), (_f64 * cap)())
+
+    def _acquire_tape(self, n_events: int):
         # generous sizing keeps R_TAPE_FULL out of steady state: a
         # finished-task chain is a handful of rows plus flips
-        self._grow_tape(min(max(32 * n_events + 4096, 1 << 14), 1 << 22))
+        cap = min(max(32 * n_events + 4096, 1 << 14), 1 << 22)
+        pool = self._tape_pool
+        if pool:
+            tape = pool.pop()
+            if tape[0] >= cap:
+                return tape
+            # too small for this flood: replace (steady-state flood
+            # sizes converge, so the pool reaches zero-alloc reuse)
+        return self._alloc_tape(cap)
+
+    def _set_tape(self, tape) -> None:
         self.lib.eng_set_tape(
-            self.h, self._t_op, self._t_a, self._t_b, self._t_c,
-            self._t_f1, self._t_f2, self._tape_cap,
+            self.h, tape[1], tape[2], tape[3], tape[4], tape[5],
+            tape[6], tape[0],
         )
+
+    # ------------------------------------------- deferred materialization
+
+    def _defer_tape(self, tape, events, round_stim: str, stim: str,
+                    now: float, client_msgs: dict,
+                    worker_msgs: dict) -> None:
+        """Park one completed native segment for later replay."""
+        n = self.lib.eng_tape_len(self.h)
+        self._pending.append(
+            (tape, n, events, round_stim, stim, now, client_msgs,
+             worker_msgs)
+        )
+        if len(self._pending) == 1:
+            _NATIVE_PENDING.append(self)
+
+    def sync(self) -> None:
+        """Materialize python truth: replay every deferred segment in
+        original order through the tape appliers.  This is the single
+        hydration point — SoA-backed property reads, ledger/telemetry
+        barriers, lazy message dicts and the mutation hooks all land
+        here.  A probe that finds nothing pending is a hydration-cache
+        hit."""
+        if self._syncing:
+            return
+        pending = self._pending
+        if not pending:
+            self.hyd_cache_hits += 1
+            return
+        self._syncing = True
+        s = self.state
+        try:
+            _NATIVE_PENDING.remove(self)
+        except ValueError:  # pragma: no cover - invariant guard
+            pass
+        s.wall.push("engine.hydrate", pending[0][4])
+        try:
+            while pending:
+                (tape, n, events, round_stim, _stim, now, cm,
+                 wm) = pending.pop(0)
+                self._applying = True
+                try:
+                    self._apply_tape_inner(
+                        tape, n, events, round_stim, cm, wm, now
+                    )
+                finally:
+                    self._applying = False
+                self.hydrations += n
+                self._tape_pool.append(tape)
+        finally:
+            # a replay exception leaves the remainder pending: restore
+            # the registry invariant so reads keep forcing (and the
+            # drives' degrade path can still detach cleanly)
+            if pending and self not in _NATIVE_PENDING:
+                _NATIVE_PENDING.append(self)
+            s.wall.pop()
+            self._syncing = False
 
     # ----------------------------------------------------- public drives
 
@@ -544,8 +791,12 @@ class NativeEngine:
             finishes = list(finishes)
         if len(finishes) < self.min_flood:
             return None  # below the amortization floor: oracle flood
-        client_msgs: dict = {}
-        worker_msgs: dict = {}
+        # lazy message dicts: deferred segments append into these at
+        # sync(), and any read of them forces the sync — callers (the
+        # server's send_all, the parity tests' canonicalizers) iterate,
+        # which materializes first
+        client_msgs: dict = _LazyMsgs(self)
+        worker_msgs: dict = _LazyMsgs(self)
         tr = s.trace
         t0 = s.clock()
         stim0 = finishes[0][2] if finishes else ""
@@ -569,7 +820,11 @@ class NativeEngine:
             while i < n:
                 if s.queued or not self.active():
                     # queue-slot passes are per-event: the oracle owns
-                    # the rest of the flood
+                    # the rest of the flood.  Materialize first — the
+                    # oracle writes messages directly, and deferred
+                    # rows must land ahead of them per destination.
+                    if self._pending:
+                        self.sync()
                     for j in range(i, n):
                         self._oracle_finished_event(
                             finishes[j], client_msgs, worker_msgs
@@ -577,7 +832,7 @@ class NativeEngine:
                     break
                 try:
                     i = self._segment_finished(
-                        finishes, i, client_msgs, worker_msgs
+                        finishes, i, t0, client_msgs, worker_msgs
                     )
                 except AssertionError:
                     raise  # DTPU_NATIVE_CHECK audit: must bite
@@ -589,6 +844,22 @@ class NativeEngine:
                     # for a dead engine (reviewer-found).
                     logger.exception(
                         "native segment failed; disabling native engine"
+                    )
+                    if s.native is self:
+                        s.native = None
+                    self.detach()
+            if s.plugins and self._pending:
+                # tape-safe plugins (stealing, sim digest, diagnostics)
+                # read their own structures between floods: their hooks
+                # must have run by flood end.  Deferral across floods is
+                # a pluginless (batch-plane/bench) property.
+                try:
+                    self.sync()
+                except AssertionError:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "flood-end sync failed; disabling native engine"
                     )
                     if s.native is self:
                         s.native = None
@@ -618,6 +889,7 @@ class NativeEngine:
                                worker_msgs, stimulus_id)
                 self.oracle_transitions += s.transition_counter - before
                 return
+        now = s.clock()
         rows, tgts = [], []
         for key, finish in recommendations.items():
             ts = s.tasks.get(key)
@@ -632,7 +904,8 @@ class NativeEngine:
             tgts.append(tgt)
         self.flush()
         self._params()
-        self._set_tape(len(rows))
+        tape = self._acquire_tape(len(rows))
+        self._set_tape(tape)
         events: list = []
         s.wall.push("engine.native", stimulus_id)
         try:
@@ -642,7 +915,11 @@ class NativeEngine:
         finally:
             s.wall.pop()
         self.segments += 1
-        self._apply_tape(events, stimulus_id, client_msgs, worker_msgs)
+        # recs rounds stay eager (defer + immediate sync): their
+        # callers consume plain message dicts, and rounds are small
+        self._defer_tape(tape, events, stimulus_id, stimulus_id, now,
+                         client_msgs, worker_msgs)
+        self.sync()
         if r != R_DONE:
             self._oracle_continue(
                 stimulus_id, client_msgs, worker_msgs,
@@ -653,8 +930,8 @@ class NativeEngine:
 
     # -------------------------------------------------- segment driving
 
-    def _segment_finished(self, finishes, i: int, client_msgs: dict,
-                          worker_msgs: dict) -> int:
+    def _segment_finished(self, finishes, i: int, now: float,
+                          client_msgs: dict, worker_msgs: dict) -> int:
         s = self.state
         seg = finishes[i:i + SEG_MAX]
         m = len(seg)
@@ -697,9 +974,11 @@ class NativeEngine:
         ev_flags = E["flags"].fill(l_flags)
         self.flush()
         self._params()
-        self._set_tape(m)
+        tape = self._acquire_tape(m)
+        self._set_tape(tape)
         consumed = _i64(0)
-        s.wall.push("engine.native", seg[0][2] if seg else "")
+        stim0 = seg[0][2] if seg else ""
+        s.wall.push("engine.native", stim0)
         try:
             r = self.lib.eng_drain_finished(
                 self.h, m, ev_task, ev_slot, ev_nbytes, ev_dur, ev_flags,
@@ -709,11 +988,19 @@ class NativeEngine:
             s.wall.pop()
         self.segments += 1
         c = consumed.value
-        self._apply_tape(seg, "", client_msgs, worker_msgs)
+        self._defer_tape(tape, seg, "", stim0, now, client_msgs,
+                         worker_msgs)
         if r == R_DONE:
+            # the steady-state fast path: the segment stays DEFERRED —
+            # no python object is touched until something reads one.
+            # Check mode audits python-vs-SoA, so it materializes first
+            # (i.e. DTPU_NATIVE_CHECK effectively disables deferral).
             if self.check:
                 self._audit()
             return i + m
+        # every escape hands control to the oracle: materialize first
+        # so the oracle reads and writes fully-ordered python truth
+        self.sync()
         if r == R_ESCAPE and self.lib.eng_escape_row(self.h) < 0:
             # event-shape escape: event c untouched natively
             self._oracle_finished_event(seg[c], client_msgs, worker_msgs)
@@ -741,6 +1028,8 @@ class NativeEngine:
         """Hand the pending rec-dict (and, on escape, the popped
         transition) to the real engine.  This IS the oracle: from here
         to quiescence the chain runs the exact scalar path."""
+        if self._pending:
+            self.sync()
         s = self.state
         lib, h = self.lib, self.h
         npend = lib.eng_pending_recs(h, self._pr_rows, self._pr_tgts,
@@ -775,6 +1064,8 @@ class NativeEngine:
                                worker_msgs: dict) -> None:
         """One whole task-finished event through the oracle — the exact
         per-event body of the batched arm (journal already written)."""
+        if self._pending:
+            self.sync()
         s = self.state
         key, worker, stimulus_id, kwargs = event
         before = s.transition_counter
@@ -815,31 +1106,23 @@ class NativeEngine:
 
     # ------------------------------------------------------ the applier
 
-    def _apply_tape(self, events, round_stim: str, client_msgs: dict,
-                    worker_msgs: dict) -> None:
-        """Replay the tape onto python truth.  Mutation ORDER mirrors
-        the oracle arms statement for statement; decisions and floats
-        come from the tape."""
-        lib, h = self.lib, self.h
-        n = lib.eng_tape_len(h)
-        self._applying = True
-        try:
-            self._apply_tape_inner(n, events, round_stim, client_msgs,
-                                   worker_msgs)
-        finally:
-            self._applying = False
-
-    def _apply_tape_inner(self, n: int, events, round_stim: str,
-                          client_msgs: dict, worker_msgs: dict) -> None:
+    def _apply_tape_inner(self, tape, n: int, events, round_stim: str,
+                          client_msgs: dict, worker_msgs: dict,
+                          now: float) -> None:
+        """Replay one tape onto python truth (always via sync()).
+        Mutation ORDER mirrors the oracle arms statement for statement;
+        decisions and floats come from the tape.  ``now`` is the
+        drive-hoisted clock stamp (ledger digests fold it verbatim, so
+        a deferred replay must stamp what the eager path would have)."""
         s = self.state
         lib, h = self.lib, self.h
         if n:
-            t_op = self._t_op[:n]
-            t_a = self._t_a[:n]
-            t_b = self._t_b[:n]
-            t_c = self._t_c[:n]
-            t_f1 = self._t_f1[:n]
-            t_f2 = self._t_f2[:n]
+            t_op = tape[1][:n]
+            t_a = tape[2][:n]
+            t_b = tape[3][:n]
+            t_c = tape[4][:n]
+            t_f1 = tape[5][:n]
+            t_f2 = tape[6][:n]
             rows = self._rows
             wslots = self._wslots
             tr = s.trace
@@ -848,9 +1131,7 @@ class NativeEngine:
             dtrack = s.durability
             led = s.ledger
             led_on = led.enabled
-            log = s.transition_log.append
-            clock = s.clock
-            now = clock()
+            log = s._transition_log.append
             shadow_on = s.telemetry.enabled
             unknown = s.unknown_durations
             cur_stim = round_stim
@@ -871,7 +1152,8 @@ class NativeEngine:
                     if led_on:
                         if ts.dependencies or ts.homed:
                             s.ledger_file_decision(
-                                ts, ws, cur_stim, None, duration, comm
+                                ts, ws, cur_stim, None, duration, comm,
+                                now=now,
                             )
                         else:
                             prefix = ts.prefix
@@ -880,7 +1162,7 @@ class NativeEngine:
                                 prefix.name if prefix is not None else "",
                                 ws.address, cur_stim, comm, comm, False,
                                 0, 0, duration, "", "",
-                                supersede=ts.ledger_row,
+                                supersede=ts.ledger_row, now=now,
                             )
                     # graft-lint: allow[mirror-parity] every touched worker is mirror-marked in the segment write-back below
                     ws.processing[ts] = duration + comm
@@ -1232,6 +1514,13 @@ class NativeEngine:
             c = int(lib.eng_escape_count(h, i))
             if c:
                 out[f"escape_{name}"] = c
+        out["hydrations"] = self.hydrations
+        out["hydration_cache_hits"] = self.hyd_cache_hits
+        rows_live = sum(1 for ts in self._rows if ts is not None)
+        pend = sum(p[1] for p in self._pending)
+        out["hydration_cache_rows"] = (
+            rows_live - pend if rows_live > pend else 0
+        )
         return out
 
     # ------------------------------------------------------------ audit
@@ -1241,6 +1530,8 @@ class NativeEngine:
         for every registered task and worker — the per-flood dual-run
         parity gate (cheap relative to check mode's purpose; property
         tests run full oracle dual-state parity on top)."""
+        if self._pending:
+            self.sync()
         s = self.state
         lib, h = self.lib, self.h
         out = self._scratch8

@@ -205,23 +205,49 @@ class TaskGroup:
         return sum(self.states.get(s, 0) for s in ("memory", "erred", "forgotten")) == self.n_tasks
 
 
+# --------------------------------------------------------------------------
+# Deferred materialization (docs/native_engine.md "authoritative SoA").
+#
+# While the native engine holds un-replayed transition records, the C++
+# SoA — not the python objects — is the source of truth for the
+# SoA-backed TaskState/WorkerState fields below.  Engines with pending
+# records park themselves in this module-level registry; ANY read or
+# write of a backed field drains it first (ordered replay through the
+# same appliers the eager path uses, so materialized python state is
+# bit-identical to the oracle's).  The registry is almost always empty
+# — the fast path is one global truthiness check.
+_NATIVE_PENDING: list = []
+
+
+def _drain_native_pending() -> None:
+    for eng in list(_NATIVE_PENDING):
+        eng.sync()
+
+
 class TaskState:
-    """Per-task record on the scheduler (reference scheduler.py:1173)."""
+    """Per-task record on the scheduler (reference scheduler.py:1173).
+
+    The fields exposed as properties below are SoA-backed: while the
+    native engine defers materialization, their python slots may lag
+    the authoritative C++ rows, and every access hydrates first (see
+    ``_NATIVE_PENDING``).  Mutate them only through the property (or
+    the registered hydration/write-back helpers — graft-lint's
+    mirror-parity rule audits direct ``_``-slot writes)."""
 
     __slots__ = (
         "key",
         "run_spec",
         "priority",
-        "state",
+        "_state",
         "dependencies",
         "dependents",
-        "waiting_on",
-        "waiters",
+        "_waiting_on",
+        "_waiters",
         "who_wants",
-        "who_has",
-        "processing_on",
-        "nbytes",
-        "type",
+        "_who_has",
+        "_processing_on",
+        "_nbytes",
+        "_type",
         "exception",
         "traceback",
         "exception_text",
@@ -237,12 +263,12 @@ class TaskState:
         "actor",
         "prefix",
         "group",
-        "metadata",
+        "_metadata",
         "annotations",
         "run_id",
         "queueable",
-        "homed",
-        "ledger_row",
+        "_homed",
+        "_ledger_row",
         "nrow",
         "_rootish",
         "_hash",
@@ -253,7 +279,9 @@ class TaskState:
         self._hash = hash(key)
         self.run_spec = run_spec
         self.priority: tuple | None = None
-        self.state = state
+        # SoA-backed slots are written directly here: a task under
+        # construction is not yet registered with any engine
+        self._state = state
         # relation fields are insertion-ordered (utils.collections.
         # OrderedSet), NOT hash-ordered sets: the transition engine's
         # recommendation order derives from iterating them, so this is
@@ -262,13 +290,13 @@ class TaskState:
         # reproduces with plain C++ vectors
         self.dependencies: OrderedSet[TaskState] = OrderedSet()
         self.dependents: OrderedSet[TaskState] = OrderedSet()
-        self.waiting_on: OrderedSet[TaskState] = OrderedSet()
-        self.waiters: OrderedSet[TaskState] = OrderedSet()
+        self._waiting_on: OrderedSet[TaskState] = OrderedSet()
+        self._waiters: OrderedSet[TaskState] = OrderedSet()
         self.who_wants: set[ClientState] = set()
-        self.who_has: OrderedSet[WorkerState] = OrderedSet()
-        self.processing_on: WorkerState | None = None
-        self.nbytes = -1
-        self.type: str | None = None
+        self._who_has: OrderedSet[WorkerState] = OrderedSet()
+        self._processing_on: WorkerState | None = None
+        self._nbytes = -1
+        self._type: str | None = None
         self.exception: Any = None
         self.traceback: Any = None
         self.exception_text = ""
@@ -284,7 +312,7 @@ class TaskState:
         self.actor = False
         self.prefix: TaskPrefix | None = None
         self.group: TaskGroup | None = None
-        self.metadata: dict | None = None
+        self._metadata: dict | None = None
         self.annotations: dict | None = None
         self.run_id: int | None = None
         self.queueable = True
@@ -294,12 +322,12 @@ class TaskState:
         # Truthy values carry provenance for the decision ledger:
         # "plan" = jax_placement plan home, "pin" = shuffle pin (same
         # steal exemption, different ledger attribution)
-        self.homed: bool | str = False
+        self._homed: bool | str = False
         # open decision-ledger row handle (ledger.py): -1 = none.  The
         # handle lives on the task instead of a key-indexed dict so the
         # file/join hot path pays no string hash; stale handles are
         # validity-checked by the ledger.
-        self.ledger_row = -1
+        self._ledger_row = -1
         # stable row in the native engine's SoA (scheduler/
         # native_engine.py): -1 = not registered
         self.nrow = -1
@@ -333,6 +361,129 @@ class TaskState:
             self.host_restrictions or self.worker_restrictions or self.resource_restrictions
         )
 
+    # SoA-backed fields: explicit property pairs (not a factory loop) so
+    # the hot oracle path pays one global truthiness check + slot access
+
+    @property
+    def state(self) -> str:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._state
+
+    @state.setter
+    def state(self, value: str) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._state = value
+
+    @property
+    def waiting_on(self) -> OrderedSet[TaskState]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._waiting_on
+
+    @waiting_on.setter
+    def waiting_on(self, value: OrderedSet[TaskState]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._waiting_on = value
+
+    @property
+    def waiters(self) -> OrderedSet[TaskState]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._waiters
+
+    @waiters.setter
+    def waiters(self, value: OrderedSet[TaskState]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._waiters = value
+
+    @property
+    def who_has(self) -> OrderedSet[WorkerState]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._who_has
+
+    @who_has.setter
+    def who_has(self, value: OrderedSet[WorkerState]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._who_has = value
+
+    @property
+    def processing_on(self) -> WorkerState | None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._processing_on
+
+    @processing_on.setter
+    def processing_on(self, value: WorkerState | None) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._processing_on = value
+
+    @property
+    def nbytes(self) -> int:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._nbytes
+
+    @nbytes.setter
+    def nbytes(self, value: int) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._nbytes = value
+
+    @property
+    def type(self) -> str | None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._type
+
+    @type.setter
+    def type(self, value: str | None) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._type = value
+
+    @property
+    def metadata(self) -> dict | None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._metadata
+
+    @metadata.setter
+    def metadata(self, value: dict | None) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._metadata = value
+
+    @property
+    def homed(self) -> bool | str:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._homed
+
+    @homed.setter
+    def homed(self, value: bool | str) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._homed = value
+
+    @property
+    def ledger_row(self) -> int:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._ledger_row
+
+    @ledger_row.setter
+    def ledger_row(self, value: int) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._ledger_row = value
+
 
 DEFAULT_DATA_SIZE = 1024  # bytes assumed for unknown results
 
@@ -356,7 +507,11 @@ class ClientState:
 
 
 class WorkerState:
-    """Scheduler-side mirror of one worker (reference scheduler.py:406)."""
+    """Scheduler-side mirror of one worker (reference scheduler.py:406).
+
+    ``nbytes``/``has_what``/``processing``/``occupancy``/``long_running``
+    are SoA-backed like the TaskState fields above: property access
+    drains pending native records first."""
 
     __slots__ = (
         "address",
@@ -364,14 +519,14 @@ class WorkerState:
         "nthreads",
         "memory_limit",
         "status",
-        "nbytes",
-        "has_what",
-        "processing",
-        "long_running",
+        "_nbytes",
+        "_has_what",
+        "_processing",
+        "_long_running",
         "executing",
         "resources",
         "used_resources",
-        "occupancy",
+        "_occupancy",
         "_network_occ",
         "last_seen",
         "status_changed_at",
@@ -399,16 +554,16 @@ class WorkerState:
         self.nthreads = nthreads
         self.memory_limit = memory_limit
         self.status = WORKER_STATUS_RUNNING
-        self.nbytes = 0
-        self.has_what: dict[TaskState, None] = {}  # insertion-ordered set
-        self.processing: dict[TaskState, float] = {}
-        self.long_running: set[TaskState] = set()
+        self._nbytes = 0
+        self._has_what: dict[TaskState, None] = {}  # insertion-ordered set
+        self._processing: dict[TaskState, float] = {}
+        self._long_running: set[TaskState] = set()
         self.executing: dict[TaskState, float] = {}
         self.resources: dict[str, float] = {}
         # diagnostics-only: placement filters by SUPPLY (valid_workers);
         # actual execution concurrency is constrained worker-side
         self.used_resources: dict[str, float] = {}
-        self.occupancy = 0.0
+        self._occupancy = 0.0
         self._network_occ = 0  # bytes pending transfer to this worker
         self.last_seen = time()
         self.status_changed_at = 0.0  # last stream-delivered status flip
@@ -441,6 +596,66 @@ class WorkerState:
         ws = WorkerState(self.address, self.nthreads, self.memory_limit, self.name)
         ws.status = self.status
         return ws
+
+    @property
+    def nbytes(self) -> int:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._nbytes
+
+    @nbytes.setter
+    def nbytes(self, value: int) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._nbytes = value
+
+    @property
+    def has_what(self) -> dict[TaskState, None]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._has_what
+
+    @has_what.setter
+    def has_what(self, value: dict[TaskState, None]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._has_what = value
+
+    @property
+    def processing(self) -> dict[TaskState, float]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._processing
+
+    @processing.setter
+    def processing(self, value: dict[TaskState, float]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._processing = value
+
+    @property
+    def long_running(self) -> set[TaskState]:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._long_running
+
+    @long_running.setter
+    def long_running(self, value: set[TaskState]) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._long_running = value
+
+    @property
+    def occupancy(self) -> float:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._occupancy
+
+    @occupancy.setter
+    def occupancy(self, value: float) -> None:
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        self._occupancy = value
 
 
 class SchedulerState:
@@ -553,7 +768,10 @@ class SchedulerState:
         )
         self.transition_counter = 0
         self.transition_counter_max = transition_counter_max
-        self.transition_log: deque = deque(
+        # SoA-backed like the TaskState fields: read through the
+        # ``transition_log`` property, which drains pending native
+        # records so deferred story rows materialize first
+        self._transition_log: deque = deque(
             maxlen=config.get("scheduler.transition-log-length")
         )
         self._transitions_table: dict[tuple[str, str], Callable] = {
@@ -916,6 +1134,14 @@ class SchedulerState:
         self.hist_engine_pass.observe(self.clock() - t0)
         self.trace.emit("engine", "transitions", stimulus_id, n=n)
         return client_msgs, worker_msgs
+
+    @property
+    def transition_log(self) -> deque:
+        """The story deque, with any deferred native records drained
+        first so pending story rows materialize before the read."""
+        if _NATIVE_PENDING:
+            _drain_native_pending()
+        return self._transition_log
 
     def story(self, *keys_or_stimuli: Key) -> list[tuple]:
         """Transition log entries touching any of the given keys/stimuli
@@ -1934,12 +2160,16 @@ class SchedulerState:
 
     def ledger_file_decision(self, ts: TaskState, ws: WorkerState,
                              stimulus_id: str, kind: str | None,
-                             duration: float, comm: float) -> None:
+                             duration: float, comm: float,
+                             now: float | None = None) -> None:
         """File one task-cost decision row (ledger.py): the prediction
         half — constant comm cost, the measured shadow's price, the
         missing-dep byte total, and the dominant dep link (best holder
         of the heaviest missing dep).  The realized half joins when the
-        task reaches memory/erred (docs/observability.md)."""
+        task reaches memory/erred (docs/observability.md).  ``now``
+        carries the flood-hoisted decision stamp when the native engine
+        replays deferred tape rows (the ledger digest folds it, so the
+        stamp must match what the eager path would have read)."""
         dep_bytes = 0
         n_deps = 0
         src = ""
@@ -1980,7 +2210,7 @@ class SchedulerState:
             kind, ts.key, prefix.name if prefix is not None else "",
             ws.address, stimulus_id, comm, measured, used,
             dep_bytes, n_deps, duration, src, plan_stim,
-            supersede=ts.ledger_row,
+            supersede=ts.ledger_row, now=now,
         )
 
     def get_replica_cost_measured(

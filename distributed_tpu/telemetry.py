@@ -229,6 +229,13 @@ class LinkTelemetry:
         # at a VirtualClock keeps simulated-transfer EWMAs and their
         # /telemetry records entirely on virtual time.
         self.clock = time
+        # deferred-materialization barrier: the scheduler's native
+        # engine points this at its sync() so a live transfer record
+        # lands AFTER any parked shadow-cost folds replay (ordering of
+        # EWMA folds is observable in divergence telemetry).  The folds
+        # that run DURING replay (shadow_comm_cost, join_row realized
+        # costs) enter below the barrier, so replay never re-enters it.
+        self.barrier: Any = None
         self.links: dict[tuple[str, str], LinkStats] = {}
         # since-heartbeat delta: (src, dst) -> [nbytes, seconds, count]
         self.since_heartbeat: dict[tuple[str, str], list] = {}
@@ -244,6 +251,9 @@ class LinkTelemetry:
         """File one transfer observed at its DESTINATION (the
         authoritative bandwidth sample: the full fetch the cost model
         prices)."""
+        b = self.barrier
+        if b is not None:
+            b()
         if not self.enabled or not src or not dst:
             return
         self._link(src, dst).fold(nbytes, seconds)
@@ -258,6 +268,9 @@ class LinkTelemetry:
         never fold into the dst-observed bandwidth EWMA (the scheduler
         re-classifies shipped rows by reporter; the local collector
         splits here)."""
+        b = self.barrier
+        if b is not None:
+            b()
         if not self.enabled or not src or not dst:
             return
         self._link(src, dst).fold_peer(nbytes, seconds)

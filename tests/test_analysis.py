@@ -468,6 +468,83 @@ def test_mirror_parity_allows_helpers_scope_and_reads(tmp_path):
     )
 
 
+# ------------------------------------------------------ soa-hydration
+
+
+def test_soa_hydration_fires_on_raw_slot_writes(tmp_path):
+    src = """
+        def sneak_state(ts):
+            ts._state = "memory"
+
+        def sneak_relation(ts, ws):
+            ts._waiting_on.add(ts)
+            ws._processing[ts] = 1.0
+            ws._occupancy += 2.0
+
+        def sneak_alias(ts):
+            push = ts._waiters.add
+            return push
+
+        def sneak_log(s, row):
+            s._transition_log.append(row)
+    """
+    found = findings_for(
+        tmp_path, {"distributed_tpu/scheduler/rogue.py": src}, "soa-hydration"
+    )
+    fields = sorted(
+        f.message.split("SoA-backed slot `")[1].split("`")[0] for f in found
+    )
+    assert fields == [
+        "_occupancy", "_processing", "_state", "_transition_log",
+        "_waiters", "_waiting_on",
+    ], found
+
+
+def test_soa_hydration_allows_registered_helpers_and_reads(tmp_path):
+    src = """
+        class TaskState:
+            def __init__(self):
+                self._state = "released"
+                self._waiting_on = set()
+
+            @property
+            def state(self):
+                return self._state
+
+            @state.setter
+            def state(self, value):
+                self._state = value
+
+        class NativeEngine:
+            def _apply_tape_inner(self, ts, s, row):
+                ts._state = "memory"
+                log = s._transition_log.append
+                log(row)
+
+            def sync(self, ts):
+                ts._nbytes = 5
+
+        def reads_are_fine(ts):
+            return ts._state, len(ts._waiting_on)
+
+        def other_underscores_are_fine(ts, obj):
+            ts._nrow_cache = 1       # not an SoA-backed slot
+            obj._state = "x"         # not a task/worker/state binding
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/scheduler/state.py": src}, "soa-hydration"
+    )
+    # worker-side state machines keep their own underscore fields
+    rogue = """
+        def worker_side(ws):
+            ws._occupancy = 1.0
+    """
+    assert not findings_for(
+        tmp_path, {"distributed_tpu/worker/state_machine.py": rogue},
+        "soa-hydration",
+    )
+
+
 # ------------------------------------------------------- wire-no-copy
 
 

@@ -60,13 +60,18 @@ def test_bench_smoke_runs_and_reports():
     assert mesh["n_workers"] > 0
     # native transition engine (native/engine.cpp; docs/native_engine.md):
     # randomized-flood bit-parity vs the python oracle, the compiled
-    # arms absorbing their share (escape rate < 10%), a same-session
-    # speedup over the 1.3x floor, and the per-flood alloc budget
-    # (the bench half raises on any violation; these pin the contract)
+    # arms absorbing their share (escape rate < 10%), the deferred-
+    # materialization contract (zero rows hydrate inside the engine
+    # timer on a no-introspection flood), a same-session engine-plane
+    # speedup over the 10x floor (whole-loop floor stays 1.3x), and
+    # the per-flood alloc budget (the bench half raises on any
+    # violation; these pin the contract)
     engine = out["configs"]["engine"]
     assert engine["parity"] is True
     assert engine["native_transitions"] > 0
     assert engine["escape_rate"] < 0.10
+    assert engine["hydrations_in_timer"] == 0
+    assert engine["speedup_engine_best"] >= 10.0
     assert engine["speedup_best"] >= 1.3
     assert engine["alloc_delta_blocks"] < 300
     assert len(mesh["engine_shards"]) >= 2

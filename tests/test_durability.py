@@ -365,6 +365,82 @@ def test_native_delta_snapshot_marks_workers_dirty():
         assert state_digest(fresh) == state_digest(state)
 
 
+def test_delta_snapshot_while_native_flood_is_deferred():
+    """Deferred materialization meets durability: a delta snapshot
+    taken while the last purely-native flood is still parked (no read
+    has hydrated its rows) must force the replay from inside
+    ``DurabilityTracker.drain`` — its dirty marks only exist after the
+    tape appliers run — or the delta captures an empty dirty set and
+    the restore's state digest diverges."""
+    from distributed_tpu import native
+
+    if native.load() is None:
+        pytest.skip("native toolchain unavailable")
+    with config.set({"scheduler.jax.enabled": False,
+                     "scheduler.work-stealing": False,
+                     "scheduler.native-engine.min-flood": 0}):
+        state = SchedulerState(validate=False)
+        if not state.attach_native(build=True):
+            pytest.skip("native engine did not attach")
+        addrs = []
+        for i in range(4):
+            state.add_worker_state(
+                f"tcp://defer:{i}", nthreads=2, memory_limit=2**30,
+                name=f"d{i}",
+            )
+            addrs.append(f"tcp://defer:{i}")
+        roots = []
+        for i in range(8):
+            k = f"defroot-{i}"
+            state.client_desires_keys([k], "def-client")
+            recs, cm, wm = state._transition(
+                k, "memory", "def-scatter", nbytes=65536,
+                worker=addrs[i % 4],
+            )
+            state._transitions(recs, cm, wm, "def-scatter")
+            roots.append(k)
+        tasks = {f"def-{i}": TaskSpec(_inc, (i,)) for i in range(40)}
+        deps = {k: {roots[i % 8]} for i, k in enumerate(tasks)}
+        state.update_graph_core(
+            tasks, deps, list(tasks), client="def-client",
+            priorities={k: (i,) for i, k in enumerate(tasks)},
+            stimulus_id="def-graph",
+        )
+        mgr = DurabilityManager(
+            state, MemorySink(), full_every=10**6, state_digests=True
+        )
+        mgr.attach()
+        ne = state.native
+        # one purely-native flood, nothing reading python truth after:
+        # the segments stay parked with their rows un-hydrated
+        batch = [
+            (ts.key, ws.address, f"def-fin-{ts.key}", {"nbytes": 8})
+            for ws in state.workers.values()
+            for ts in list(ws.processing)
+        ]
+        assert batch
+        state.stimulus_tasks_finished_batch(batch)
+        assert ne._pending, "flood did not defer (premise)"
+        mgr.snapshot()  # delta over un-hydrated rows: drain must sync
+        assert not ne._pending, "drain() did not materialize first"
+        # finish the workload and round-trip the full image
+        while True:
+            batch = [
+                (ts.key, ws.address, f"def-fin2-{ts.key}", {"nbytes": 8})
+                for ws in state.workers.values()
+                for ts in list(ws.processing)
+            ]
+            if not batch:
+                break
+            state.stimulus_tasks_finished_batch(batch)
+        mgr.snapshot()
+        mgr.flush_journal()
+        assert ne.counters()["transitions"] > 0
+        fresh = SchedulerState(validate=False)
+        DurabilityManager.restore_into(fresh, mgr.sink)
+        assert state_digest(fresh) == state_digest(state)
+
+
 def test_snapshot_epoch_gap_rejected():
     """A delta snapshot lost to a swallowed off-loop sink write (the
     live threaded sink logs-and-drops failures) must fail the load

@@ -230,6 +230,108 @@ def test_multiflood_parity(seed):
     assert oracle.transition_counter == nat.transition_counter
 
 
+def _drive_probed(state, seed=0):
+    """_drive plus randomized introspection between batches: every
+    probe is a hydration barrier on the native side (TaskState /
+    WorkerState properties, the story deque, the ledger digest, the
+    returned lazy message dicts).  Returns the probe results so the
+    harness can compare them bit-for-bit across engines."""
+    rng = random.Random(seed ^ 0x5EED)
+    probes = []
+    rounds = 0
+    with config.set(OVR):
+        while True:
+            batch = [
+                (
+                    ts.key, ws.address, f"fin-{rounds}-{i}",
+                    {
+                        "nbytes": 1024 + (hash(ts.key) % 7) * 512,
+                        "typename": "int",
+                        "startstops": [{
+                            "action": "compute", "start": 0.0,
+                            "stop": 0.01,
+                        }],
+                    },
+                )
+                for ws in state.workers.values()
+                for i, ts in enumerate(list(ws.processing))
+            ]
+            if not batch:
+                break
+            state.clock.step()
+            cm, wm = state.stimulus_tasks_finished_batch(batch)
+            keys = sorted(state.tasks)
+            for _ in range(rng.randrange(4)):
+                ts = state.tasks[keys[rng.randrange(len(keys))]]
+                probes.append((
+                    ts.key, ts.state, ts.nbytes,
+                    tuple(sorted(w.address for w in ts.who_has)),
+                    tuple(sorted(d.key for d in ts.waiters)),
+                ))
+            if rng.random() < 0.5:
+                probes.append(len(state.transition_log))
+            if rng.random() < 0.4:
+                probes.append(state.ledger.digest())
+            if rng.random() < 0.4:
+                probes.append(sorted(
+                    (dest, len(msgs)) for dest, msgs in wm.items()
+                ))
+            if rng.random() < 0.4:
+                addrs = sorted(state.workers)
+                ws = state.workers[addrs[rng.randrange(len(addrs))]]
+                probes.append((
+                    ws.address, ws.occupancy, ws.nbytes,
+                    len(ws.processing),
+                ))
+            rounds += 1
+            assert rounds < 5000
+    return probes
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_randomized_introspection_parity(seed):
+    """The lazy-hydration property test: arbitrary python-truth reads
+    between batches land on identical truth at the moment of the read,
+    and the whole trace stays bit-identical vs the oracle — states,
+    stories, journal, ledger digests AND the probe results themselves."""
+    oracle, nat = _build_pair(seed=seed, journal=True)
+    po = _drive_probed(oracle, seed=seed)
+    pn = _drive_probed(nat, seed=seed)
+    c = nat.native.counters()
+    assert c["transitions"] > 0, "native never ran"
+    assert c["hydrations"] > 0, "nothing was ever deferred"
+    assert c["hydration_cache_hits"] > 0, \
+        "every probe forced a replay — the cache never hit"
+    assert po == pn
+    assert _snapshot(oracle) == _snapshot(nat)
+    assert _stories(oracle) == _stories(nat)
+    assert list(oracle.trace.journal) == list(nat.trace.journal)
+    assert oracle.ledger.digest() == nat.ledger.digest()
+    assert oracle.transition_counter == nat.transition_counter
+
+
+def test_no_introspection_flood_defers_fully():
+    """A purely-native flood with nothing reading python truth parks
+    its segments: zero tape rows hydrate inside the flood, and the
+    first later read (here: the message dict) replays them all."""
+    _oracle, nat = _build_pair(seed=13, width=16, layers=2)
+    ne = nat.native
+    batch = [
+        (ts.key, ws.address, "nf", {"nbytes": 8})
+        for ws in nat.workers.values()
+        for ts in list(ws.processing)
+    ]
+    assert batch
+    h0 = ne.hydrations
+    cm, wm = nat.stimulus_tasks_finished_batch(batch)
+    assert ne._pending, "flood did not defer"
+    assert ne.hydrations == h0, "flood hydrated rows with no reader"
+    n_msgs = sum(len(v) for v in wm.values())  # lazy read: forces sync
+    assert not ne._pending
+    assert ne.hydrations > h0
+    assert n_msgs > 0
+
+
 def test_parity_with_erred_floods_and_restrictions():
     """Erred floods (uncompiled arm) and restricted tasks force per-key
     escapes; outputs stay bit-identical."""

@@ -1263,7 +1263,10 @@ def test_metrics_names_unique_and_documented():
     if _Sched.state.native is not None:
         assert {"dtpu_engine_native_transitions_total",
                 "dtpu_engine_native_escapes_total",
-                "dtpu_engine_native_oracle_transitions_total"} <= all_names
+                "dtpu_engine_native_oracle_transitions_total",
+                "dtpu_engine_hydrations_total",
+                "dtpu_engine_hydration_cache_hits_total",
+                "dtpu_engine_hydration_cache_rows"} <= all_names
     undocumented = sorted(n for n in all_names if n not in docs)
     assert not undocumented, (
         f"metrics missing from the docs/observability.md table: "
