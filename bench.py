@@ -2904,6 +2904,32 @@ def _smoke_census() -> dict:
     }
 
 
+def _smoke_lint() -> dict:
+    """The determinism lint gate rides the smoke: bench headlines are
+    only comparable across runs and processes if every scheduling
+    decision is hash-seed- and allocation-independent
+    (docs/determinism.md), so --smoke refuses to bless a tree with
+    determinism findings."""
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.analysis",
+         "--rule", "determinism", "--format", "json"],
+        capture_output=True, text=True, timeout=180,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    report = json.loads(r.stdout)
+    assert report["findings"] == [], report["findings"]
+    assert report["errors"] == [], report["errors"]
+    return {
+        "rule": "determinism",
+        "findings": 0,
+        "suppressed": report["suppressed"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 def run_smoke(only: str | None = None):
     """``python bench.py --smoke [name]``: tiny CPU-pinned configs; one
     JSON line on stdout; raises (non-zero exit) on any failure.  With a
@@ -2939,6 +2965,7 @@ def run_smoke(only: str | None = None):
         "sim": _smoke_sim,
         "restart": lambda: retry_once(_smoke_restart),
         "census": lambda: retry_once(_smoke_census),
+        "lint": _smoke_lint,
         # "mesh" LAST on purpose: the sharded programs spin up the
         # 8-device XLA runtime (one thread pool per virtual device on a
         # 2-core box) and that background churn measurably widens the

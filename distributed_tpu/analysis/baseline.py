@@ -40,9 +40,16 @@ class AllowEntry:
     used: bool = False
 
     def matches(self, finding: "Finding") -> bool:
-        if self.rule != finding.rule or self.path != finding.path:
+        if self.rule != finding.rule:
             return False
-        if self.symbol and self.symbol != finding.symbol:
+        if self.path != finding.path:
+            # (rule, qualname) beats path: a baselined finding whose
+            # enclosing symbol moved file intact stays suppressed,
+            # instead of double-reporting as one stale + one new
+            # finding.  Entries without a symbol still pin their path.
+            if not (self.symbol and self.symbol == finding.symbol):
+                return False
+        elif self.symbol and self.symbol != finding.symbol:
             return False
         if self.line and self.line != finding.line:
             return False
@@ -104,3 +111,59 @@ class Baseline:
             for e in self.entries
             if not e.used
         ]
+
+    def prune(self, path: Path) -> list[str]:
+        """Rewrite ``path`` in place dropping entries whose ``used``
+        flag is still False after a full lint run.  Live entries keep
+        their original text verbatim — comments, key order, reasons.
+        Returns the dropped-entry descriptions; raises ``ValueError``
+        when the baseline has load errors (pruning would silently eat
+        the malformed blocks)."""
+        if self.errors:
+            raise ValueError(
+                "refusing to prune a baseline with errors: "
+                + "; ".join(self.errors)
+            )
+        if not path.is_file():
+            return []
+        preamble, blocks = split_allow_blocks(path.read_text())
+        if len(blocks) != len(self.entries):  # pragma: no cover - guard
+            raise ValueError(
+                f"baseline drifted since load: {len(blocks)} [[allow]] "
+                f"blocks on disk vs {len(self.entries)} loaded entries"
+            )
+        kept = [b for b, e in zip(blocks, self.entries) if e.used]
+        dropped = self.unused()
+        if not dropped:
+            return []
+        text = preamble + "".join(kept)
+        # a fully-pruned file keeps its preamble (doc header) only
+        path.write_text(text if text.endswith("\n") or not text else text + "\n")
+        return dropped
+
+
+def split_allow_blocks(text: str) -> tuple[str, list[str]]:
+    """Split baseline TOML into (preamble, one block per ``[[allow]]``
+    table).  A block owns the comment lines immediately above its
+    ``[[allow]]`` header (no blank line in between), so pruning keeps a
+    live entry's rationale comments with it.  tomllib preserves array
+    order, so block i corresponds to ``data["allow"][i]``."""
+    lines = text.splitlines(keepends=True)
+    starts = [
+        i for i, ln in enumerate(lines) if ln.strip() == "[[allow]]"
+    ]
+    if not starts:
+        return text, []
+    # pull directly-attached comments into their block
+    owned: list[int] = []
+    for s in starts:
+        j = s
+        while j > 0 and lines[j - 1].strip().startswith("#"):
+            j -= 1
+        owned.append(j)
+    preamble = "".join(lines[: owned[0]])
+    blocks = []
+    for k, start in enumerate(owned):
+        end = owned[k + 1] if k + 1 < len(owned) else len(lines)
+        blocks.append("".join(lines[start:end]))
+    return preamble, blocks

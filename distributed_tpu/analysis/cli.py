@@ -39,6 +39,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="extract the task state machines and write "
                              "<name>.json/<name>.dot per machine to DIR "
                              "(the docs/state_machine/ artifacts)")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="after a full lint run, rewrite the baseline "
+                             "file in place dropping stale entries (live "
+                             "entries keep their comments verbatim)")
     parser.add_argument("--verbose", "-v", action="store_true")
     args = parser.parse_args(argv)
 
@@ -84,11 +88,24 @@ def main(argv: list[str] | None = None) -> int:
                   f"{len(machine.emissions)} emissions)", file=sys.stderr)
         return 0
 
+    if args.prune_baseline and args.rules:
+        # a filtered run marks every other rule's entries unused; pruning
+        # on that evidence would drop live suppressions
+        parser.error("--prune-baseline needs a full run; drop --rule")
+
     baseline = Baseline.load(root / config.baseline_file)
     result = run_lint(
         root, config=config, baseline=baseline, rule_names=args.rules,
         log=(lambda m: print(f"# {m}", file=sys.stderr)) if args.verbose else None,
     )
+
+    pruned: list[str] = []
+    if args.prune_baseline:
+        try:
+            pruned = baseline.prune(root / config.baseline_file)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.format == "json":
         print(json.dumps({
@@ -96,6 +113,7 @@ def main(argv: list[str] | None = None) -> int:
             "errors": result.errors,
             "suppressed": result.suppressed,
             "stale_baseline": result.stale_baseline,
+            "pruned_baseline": pruned,
             "exit_code": result.exit_code,
         }, indent=2))
         return result.exit_code
@@ -105,7 +123,10 @@ def main(argv: list[str] | None = None) -> int:
     for finding in result.findings:
         print(finding.format())
     for stale in result.stale_baseline:
-        print(f"warning: stale baseline entry (matched nothing): {stale}")
+        if args.prune_baseline:
+            print(f"pruned stale baseline entry: {stale}")
+        else:
+            print(f"warning: stale baseline entry (matched nothing): {stale}")
     n = len(result.findings)
     print(
         f"graft-lint: {n} finding{'s' if n != 1 else ''}, "

@@ -4,6 +4,7 @@ from distributed_tpu.analysis.rules import (  # noqa: F401
     await_atomicity,
     blocking_async,
     config_keys,
+    determinism,
     handler_parity,
     jit_purity,
     mirror_parity,

@@ -28,6 +28,7 @@ from typing import TYPE_CHECKING, Any, Generator, Iterable
 from distributed_tpu import config
 from distributed_tpu.graph.spec import Key
 from distributed_tpu.rpc.core import PeriodicCallback
+from distributed_tpu.utils.collections import OrderedSet
 from distributed_tpu.utils.misc import import_term, seq_name
 
 if TYPE_CHECKING:
@@ -47,7 +48,9 @@ class ActiveMemoryManagerExtension:
                  interval: float | None = None):
         self.scheduler = scheduler
         self.state = scheduler.state
-        self.policies: set[ActiveMemoryManagerPolicy] = set()
+        # registration-ordered: policy run order decides suggestion
+        # precedence within a round, so it must not be hash-ordered
+        self.policies: OrderedSet[ActiveMemoryManagerPolicy] = OrderedSet()
         if policies is None:
             policies = []
             for spec in config.get("scheduler.active-memory-manager.policies"):
@@ -204,7 +207,11 @@ class ActiveMemoryManagerExtension:
 
     def _handle_suggestion(self, cmd: Suggestion) -> None:
         op, ts, candidates = cmd
-        recipients, droppers = self.pending.setdefault(ts, (set(), set()))
+        # decision order: these are iterated to file ledger rows and
+        # build the acquire/remove envelopes
+        recipients, droppers = self.pending.setdefault(
+            ts, (OrderedSet(), OrderedSet())
+        )
         if op == "replicate":
             ws = self._find_recipient(ts, candidates, recipients)
             if ws is not None:
@@ -234,7 +241,9 @@ class ActiveMemoryManagerExtension:
         candidates -= pending_repl
         if not candidates:
             return None
-        return min(candidates, key=self._projected)
+        # address tiebreak: equal projections must not fall back to
+        # hash-seed set order
+        return min(candidates, key=lambda ws: (self._projected(ws), ws.address))
 
     def _find_dropper(self, ts: "TaskState", candidates, pending_repl,
                       pending_drop) -> "WorkerState | None":
@@ -256,7 +265,9 @@ class ActiveMemoryManagerExtension:
         }
         if not candidates:
             return None
-        return max(candidates, key=self._projected)
+        # address tiebreak: equal projections must not fall back to
+        # hash-seed set order
+        return max(candidates, key=lambda ws: (self._projected(ws), ws.address))
 
 
 class ActiveMemoryManagerPolicy:

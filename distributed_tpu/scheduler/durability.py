@@ -288,7 +288,9 @@ def _task_row(state: Any, ts: Any) -> dict:
     if ts.exception_blame is not None:
         row["blame"] = ts.exception_blame.key
     if ts.erred_on:
-        row["erred_on"] = sorted(ts.erred_on)
+        # insertion order, not sorted: the restored OrderedSet must
+        # iterate exactly like the original (free-keys message order)
+        row["erred_on"] = list(ts.erred_on)
     if ts.suspicious:
         row["susp"] = ts.suspicious
     if ts.retries:
@@ -703,7 +705,7 @@ def restore_state(state: Any, rows: dict) -> None:
         ts.exception_text = row.get("extext", "")
         ts.traceback_text = row.get("tbtext", "")
         if row.get("erred_on"):
-            ts.erred_on = set(row["erred_on"])
+            ts.erred_on = OrderedSet(row["erred_on"])
         ts.suspicious = int(row.get("susp", 0))
         ts.retries = int(row.get("retry", 0))
         if row.get("hostr") is not None:

@@ -268,7 +268,10 @@ class SchedulerMirror:
         state = self.state
         idle = state.idle
         running = state.running
-        for slot in self._dirty:
+        # ascending slot order: refresh writes commute per slot, but a
+        # deterministic walk keeps upsert/scatter row order (and any
+        # digest over it) hash-seed-independent
+        for slot in sorted(self._dirty):
             ws = self.ws_of[slot]
             if ws is None:
                 self.nthreads[slot] = 0
@@ -365,7 +368,7 @@ class SchedulerMirror:
         # bound path this cache exists for
         if self._device_dirty and self._dev:
             n_changed = len(self._device_dirty)
-            rows = np.fromiter(self._device_dirty, np.int32, n_changed)
+            rows = np.fromiter(sorted(self._device_dirty), np.int32, n_changed)
             # pow2-pad the scatter (repeat a real row; identical values,
             # so duplicates are harmless) to bound jit-shape churn
             pad = _bucket(n_changed)
@@ -461,7 +464,7 @@ class SchedulerMirror:
             # owning shard and ship each shard ONLY its rows (pow2-
             # padded with a repeated real row to bound jit-shape churn)
             by_shard: dict[int, list[int]] = {}
-            for slot in self._sdev_dirty:
+            for slot in sorted(self._sdev_dirty):
                 by_shard.setdefault(slot // rows_per_shard, []).append(slot)
             for shard_i, slots in sorted(by_shard.items()):
                 n_changed = len(slots)

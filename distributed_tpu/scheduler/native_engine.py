@@ -55,6 +55,7 @@ from distributed_tpu.scheduler.state import (
     _NATIVE_PENDING,
     _merge_msgs_inplace as _merge,
 )
+from distributed_tpu.utils.collections import OrderedSet
 
 if TYPE_CHECKING:  # pragma: no cover
     from distributed_tpu.scheduler.state import (
@@ -249,8 +250,11 @@ class NativeEngine:
         self._prefix_ids: dict[str, int] = {}
         self._group_ids: dict[str, int] = {}
         # dirty sets (python-side mutations pending resync)
-        self._dirty: set = set()
-        self._dirty_workers: set = set()
+        # insertion-ordered: flush visit order assigns first-sight
+        # prefix/group ids and fills the SoA relation vectors, so it
+        # must not be hash-seed order
+        self._dirty: OrderedSet = OrderedSet()
+        self._dirty_workers: OrderedSet = OrderedSet()
         # row indices allocated but never yet flushed into the SoA:
         # lets the census walk compare python rows against the C++
         # live count without forcing a flush (fresh ⊆ dirty always)

@@ -1710,7 +1710,12 @@ class Scheduler(Server):
             if survivors:
                 for ts in list(ws.has_what):
                     if len(ts.who_has) == 1:
-                        target = min(survivors, key=lambda w: w.nbytes)
+                        # address tiebreak: survivors come from the
+                        # ``running`` set, so equal nbytes must not fall
+                        # back to hash-seed order
+                        target = min(
+                            survivors, key=lambda w: (w.nbytes, w.address)
+                        )
                         resp = await self.rpc(target.address).gather(
                             who_has={ts.key: [addr]}
                         )

@@ -292,7 +292,9 @@ class TaskState:
         self.dependents: OrderedSet[TaskState] = OrderedSet()
         self._waiting_on: OrderedSet[TaskState] = OrderedSet()
         self._waiters: OrderedSet[TaskState] = OrderedSet()
-        self.who_wants: set[ClientState] = set()
+        # insertion-ordered like the relation fields: report/erred
+        # client messages are emitted by iterating this
+        self.who_wants: OrderedSet[ClientState] = OrderedSet()
         self._who_has: OrderedSet[WorkerState] = OrderedSet()
         self._processing_on: WorkerState | None = None
         self._nbytes = -1
@@ -302,7 +304,9 @@ class TaskState:
         self.exception_text = ""
         self.traceback_text = ""
         self.exception_blame: TaskState | None = None
-        self.erred_on: set[str] = set()
+        # insertion-ordered: free-keys messages are built by iterating
+        # this (one worker_msgs row per erred-on address)
+        self.erred_on: OrderedSet[str] = OrderedSet()
         self.suspicious = 0
         self.retries = 0
         self.host_restrictions: set[str] | None = None
@@ -495,7 +499,9 @@ class ClientState:
 
     def __init__(self, client: str, now: float | None = None):
         self.client_key = client
-        self.wants_what: set[TaskState] = set()
+        # insertion-ordered: client-releases and restart paths iterate
+        # this to build key lists
+        self.wants_what: OrderedSet[TaskState] = OrderedSet()
         self.last_seen = now if now is not None else time()
         self.versions: dict = {}
 
@@ -761,7 +767,9 @@ class SchedulerState:
         self.parked: dict[str, HeapSet[TaskState]] = {}
         self._parked_keys: dict[Key, str] = {}
         self.unrunnable: dict[TaskState, float] = {}
-        self.replicated_tasks: set[TaskState] = set()
+        # insertion-ordered: ReduceReplicas iterates this to yield
+        # drop suggestions (amm.py), so scan order is decision order
+        self.replicated_tasks: OrderedSet[TaskState] = OrderedSet()
 
         self.validate = (
             validate if validate is not None else config.get("scheduler.validate")
@@ -3055,7 +3063,7 @@ class SchedulerState:
 
     def stimulus_retry(self, keys: Iterable[Key], stimulus_id: str) -> tuple[dict, dict]:
         """Re-run erred tasks (reference scheduler.py:5131)."""
-        roots: set[Key] = set()
+        roots: OrderedSet[Key] = OrderedSet()
         for key in keys:
             ts = self.tasks.get(key)
             if ts is None:

@@ -113,7 +113,7 @@ class SyntheticDag:
         lo = c * self.layers_per_chunk
         hi = min(lo + self.layers_per_chunk, self.n_layers)
         tasks: list[str] = []
-        deps: dict[str, set[str]] = {}
+        deps: dict[str, list[str]] = {}
         priorities: dict[str, tuple] = {}
         dmin, dmax = self.duration_range
         bmin, bmax = self.nbytes_range
@@ -122,7 +122,13 @@ class SyntheticDag:
         for j in range(lo, hi):
             layer = [f"c{c}L{j}-{i}" for i in range(self.layer_width)]
             for i, key in enumerate(layer):
-                fan = {prev[rng.randrange(len(prev))] for _ in range(self.fanin)}
+                # draw-order dedupe, NOT a set comprehension: the deps
+                # iteration order at graph ingest becomes the relation
+                # sets' insertion order (= recommendation/digest order),
+                # so it must be rng-derived, never hash-seed-derived
+                fan = list(dict.fromkeys(
+                    prev[rng.randrange(len(prev))] for _ in range(self.fanin)
+                ))
                 deps[key] = fan
                 priorities[key] = (self._rank,)
                 self._rank += 1

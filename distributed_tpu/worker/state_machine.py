@@ -39,7 +39,7 @@ from distributed_tpu.diagnostics.census import build_worker_census
 from distributed_tpu.diagnostics.selfprofile import WallBudget
 from distributed_tpu.exceptions import InvalidTaskState, InvalidTransition
 from distributed_tpu.tracing import FlightRecorder
-from distributed_tpu.utils import HeapSet
+from distributed_tpu.utils import HeapSet, OrderedSet
 
 logger = logging.getLogger("distributed_tpu.worker.state")
 
@@ -108,11 +108,16 @@ class WTaskState:
         self.previous: str | None = None  # for cancelled/resumed
         self.next: str | None = None
         self.priority = priority
-        self.dependencies: set[WTaskState] = set()
-        self.dependents: set[WTaskState] = set()
-        self.waiting_for_data: set[WTaskState] = set()
-        self.waiters: set[WTaskState] = set()
-        self.who_has: set[str] = set()
+        # insertion-ordered (utils.collections.OrderedSet), NOT
+        # hash-ordered sets: the worker machine iterates these to build
+        # recommendations, fetch queues (data_needed row creation) and
+        # instructions, so iteration order is decision order — same
+        # contract as the scheduler's relation fields (PR 13)
+        self.dependencies: OrderedSet[WTaskState] = OrderedSet()
+        self.dependents: OrderedSet[WTaskState] = OrderedSet()
+        self.waiting_for_data: OrderedSet[WTaskState] = OrderedSet()
+        self.waiters: OrderedSet[WTaskState] = OrderedSet()
+        self.who_has: OrderedSet[str] = OrderedSet()
         self.coming_from: str | None = None
         self.nbytes = 0
         self.duration: float = -1
@@ -438,17 +443,19 @@ class WorkerState:
         self.tasks: dict[Key, WTaskState] = {}
         self.ready: HeapSet[WTaskState] = HeapSet(key=lambda ts: ts.priority)
         self.constrained: deque[WTaskState] = deque()
-        self.executing: set[WTaskState] = set()
-        self.long_running: set[WTaskState] = set()
-        self.in_flight_tasks: set[WTaskState] = set()
-        self.missing_dep_flight: set[WTaskState] = set()
+        # insertion-ordered: cancellation/pause sweeps and the census
+        # walk these, and missing-dep retries re-enqueue in scan order
+        self.executing: OrderedSet[WTaskState] = OrderedSet()
+        self.long_running: OrderedSet[WTaskState] = OrderedSet()
+        self.in_flight_tasks: OrderedSet[WTaskState] = OrderedSet()
+        self.missing_dep_flight: OrderedSet[WTaskState] = OrderedSet()
         # fetch queues: per-peer heap of tasks to pull
         self.data_needed: defaultdict[str, HeapSet[WTaskState]] = defaultdict(
             lambda: HeapSet(key=lambda ts: ts.priority)
         )
-        self.in_flight_workers: dict[str, set[Key]] = {}
-        self.busy_workers: set[str] = set()
-        self.has_what: defaultdict[str, set[Key]] = defaultdict(set)
+        self.in_flight_workers: dict[str, OrderedSet[Key]] = {}
+        self.busy_workers: OrderedSet[str] = OrderedSet()
+        self.has_what: defaultdict[str, OrderedSet[Key]] = defaultdict(OrderedSet)
         self.actors: dict[Key, Any] = {}
         self.total_resources = dict(resources or {})
         self.available_resources = dict(resources or {})
@@ -688,7 +695,7 @@ class WorkerState:
             # otherwise strand them forever (census-found)
             for w in dts.who_has.difference(workers):
                 self._drop_has_what(w, dep_key)
-            dts.who_has = set(workers)
+            dts.who_has = OrderedSet(workers)
             dts.nbytes = ev.nbytes.get(dep_key, dts.nbytes)
             ts.dependencies.add(dts)
             dts.dependents.add(ts)
@@ -960,7 +967,7 @@ class WorkerState:
                 ts.priority = (1_000_000,)  # replicas fetch at low priority
             for w in ts.who_has.difference(workers):
                 self._drop_has_what(w, key)
-            ts.who_has = set(workers)
+            ts.who_has = OrderedSet(workers)
             ts.nbytes = ev.nbytes.get(key, ts.nbytes)
             if ts.state in ("released", "missing") and key not in self.data:
                 recs[ts] = "fetch"
@@ -1036,7 +1043,7 @@ class WorkerState:
             # departed replica behind (census-found)
             for w in ts.who_has.difference(workers):
                 self._drop_has_what(w, key)
-            ts.who_has = set(workers)
+            ts.who_has = OrderedSet(workers)
             for w in workers:
                 self.has_what[w].add(key)
             if ts.state == "missing" and ts.who_has:
@@ -1597,7 +1604,7 @@ class WorkerState:
             to_gather, total_nbytes = self._select_keys_for_gather(worker)
             if not to_gather:
                 break
-            self.in_flight_workers[worker] = set(to_gather)
+            self.in_flight_workers[worker] = OrderedSet(to_gather)
             self.transfer_incoming_count += 1
             recs: Recs = {}
             for key in to_gather:

@@ -175,6 +175,12 @@ def test_bench_smoke_runs_and_reports():
     assert census["live_clean"] is True
     assert census["live_censuses"] == 3  # scheduler + 2 workers
     assert census["live_families"] > 100
+    # determinism lint gate (analysis/rules/determinism.py,
+    # docs/determinism.md): the tree has no hash-seed-ordered decision
+    # path, so the bench numbers above are comparable across processes
+    lint = out["configs"]["lint"]
+    assert lint["rule"] == "determinism"
+    assert lint["findings"] == 0
     sim = out["configs"]["sim"]
     assert sim["deterministic"] is True
     assert sim["virtual_makespan_s"] > 0

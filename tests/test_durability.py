@@ -721,26 +721,7 @@ def test_scenario_scheduler_bounce_native():
     assert rep["keys_done"] >= rep["keys_wanted"]
 
 
-def test_scenario_scheduler_bounce_hashseed_sweep():
-    """The bounce proof across PYTHONHASHSEEDs.  Seeds 6 and 8 used to
-    diverge the bounced run from its unbounced twin: the restored
-    ``stealable`` level sets (and ``saturated``/``idle_task_count``)
-    were plain hash-ordered sets, so the first post-restore balance
-    cycle stole tasks in an allocation-dependent order the twin never
-    saw.  Insertion-ordered collections (OrderedSet) + the snapshot's
-    recorded orders make every seed a deterministic pass."""
-    import subprocess
-    import sys
-
-    for seed in ("6", "8"):
-        env = dict(os.environ, PYTHONHASHSEED=seed)
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest",
-             "tests/test_durability.py::test_scenario_scheduler_bounce_oracle",
-             "-q", "-p", "no:randomly"],
-            capture_output=True, timeout=240, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        assert r.returncode == 0, (
-            f"seed {seed}: " + r.stdout.decode()[-1500:]
-        )
+# The PYTHONHASHSEED sweep of the bounce proof lives with the rest of
+# the hashseed harness: tests/test_determinism.py::
+# test_bounce_scenario_across_hashseeds (seeds 6/8 caught the original
+# plain-set ``stealable``/``saturated`` divergence).

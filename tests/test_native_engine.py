@@ -674,46 +674,24 @@ def test_ordered_set_semantics():
         s.remove("a")
 
 
-def test_partition_chaos_hashseed_sweep():
-    """The partition chaos scenario across several PYTHONHASHSEEDs:
-    the worker machine still iterates plain sets, so its event order
-    is seed-dependent — seeds 1 and 6 (and 5/11 on the parent commit)
-    used to crash `(released, memory)` with an unexpected ``payload``
-    when an in-flight execute completed for a released task.  Now that
-    the scheduler side is insertion-ordered, each seed is a
-    deterministic repro."""
-    for seed in ("1", "6"):
-        env = dict(os.environ, PYTHONHASHSEED=seed)
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest",
-             "tests/test_sim.py::test_chaos_partition", "-q"],
-            capture_output=True, timeout=240, env=env,
-            cwd=os.path.dirname(os.path.dirname(__file__)),
-        )
-        assert r.returncode == 0, (
-            f"seed {seed}: " + r.stdout.decode()[-1500:]
-        )
+# The PYTHONHASHSEED sweep of the partition chaos scenario lives with
+# the rest of the hashseed harness: tests/test_determinism.py::
+# test_partition_chaos_across_hashseeds (seeds 1/6 caught the original
+# `(released, memory)` crash).
 
 
 def test_ordered_set_determinism_across_hashseed():
     """Iteration order is insertion order, independent of
     PYTHONHASHSEED — the property the engine's cross-process
     determinism rests on."""
-    code = (
+    from conftest import sweep_hashseed_stdout
+
+    out = sweep_hashseed_stdout(
         "from distributed_tpu.utils.collections import OrderedSet\n"
         "s = OrderedSet()\n"
         "for x in ['k%d' % i for i in range(50)]: s.add(x)\n"
         "s.discard('k7'); s.add('k7')\n"
-        "print(','.join(s))\n"
+        "print(','.join(s))\n",
+        seeds=("0", "1", "2"), timeout=60,
     )
-    outs = set()
-    for seed in ("0", "1", "2"):
-        env = dict(os.environ, PYTHONHASHSEED=seed)
-        r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True,
-            timeout=60, env=env,
-            cwd=os.path.dirname(os.path.dirname(__file__)),
-        )
-        assert r.returncode == 0, r.stderr.decode()
-        outs.add(r.stdout.decode().strip())
-    assert len(outs) == 1
+    assert out.strip().startswith("k0,k1,")
